@@ -1,0 +1,24 @@
+"""dtdl_tpu — a TPU-native distributed-training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of
+MyXiaoPao/distributed-training-dl (the reference collection of per-framework
+distributed-training examples): single-device training, single-process
+multi-device data parallelism, multi-process/multi-host allreduce data
+parallelism, dataset sharding/scatter, checkpoint/resume, metric logging, and
+per-example CLIs — expressed as SPMD programs over a `jax.sharding.Mesh`, with
+gradient synchronization as XLA collectives over ICI/DCN instead of NCCL/MPI.
+
+Subpackages
+-----------
+runtime   process bootstrap, topology discovery, mesh construction
+parallel  parallelism strategies (DP/DDP), collectives adapter
+models    MLP / MNIST-CNN / PyramidNet / ResNet flax modules
+ops       classification losses (XLA-fused; pallas kernels as they pay off)
+train     jitted train-step engine (state, train/eval/predict steps)
+utils     flags, seeding, timing
+"""
+
+__version__ = "0.1.0"
+
+from dtdl_tpu.runtime.mesh import build_mesh, local_mesh  # noqa: F401
+from dtdl_tpu.runtime.bootstrap import initialize, is_leader  # noqa: F401

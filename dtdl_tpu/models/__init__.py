@@ -1,0 +1,36 @@
+"""Model zoo: the reference's three architectures plus ResNet-50.
+
+All models are flax.linen modules in NHWC layout (the TPU-native layout —
+convolutions tile directly onto the MXU), with a ``dtype`` knob for bfloat16
+compute and float32 parameters.
+"""
+
+from dtdl_tpu.models.mlp import MLP  # noqa: F401
+from dtdl_tpu.models.cnn import MnistCNN  # noqa: F401
+from dtdl_tpu.models.pyramidnet import PyramidNet, pyramidnet  # noqa: F401
+from dtdl_tpu.models.resnet import ResNet, ResNet50, resnet50  # noqa: F401
+
+_REGISTRY = {
+    "mlp": lambda **kw: MLP(**kw),
+    "mnist_cnn": lambda **kw: MnistCNN(**kw),
+    "pyramidnet": lambda **kw: pyramidnet(**kw),
+    "resnet50": lambda **kw: resnet50(**kw),
+}
+
+
+def get_model(name: str, **kwargs):
+    try:
+        return _REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; have {sorted(_REGISTRY)}") from None
+
+
+def input_spec(name: str) -> tuple[tuple[int, ...], str]:
+    """(example input shape without batch dim, dataset name) per model."""
+    return {
+        "mlp": ((784,), "mnist"),
+        "mnist_cnn": ((28, 28, 1), "mnist"),
+        "pyramidnet": ((32, 32, 3), "cifar10"),
+        "resnet50": ((224, 224, 3), "imagenet"),
+    }[name]

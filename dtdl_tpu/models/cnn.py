@@ -1,0 +1,36 @@
+"""MNIST 3-conv CNN.
+
+Capability parity with the Keras Sequential CNN duplicated across the three
+TF2 scripts (reference tensorflow2/mnist_single.py:14-30 ≡
+mnist_mirror_strategy.py and mnist_multi_worker_strategy.py copies): Conv 32
+3x3 VALID + ReLU, MaxPool 2, Conv 64 3x3 + ReLU, MaxPool 2, Conv 64 3x3 +
+ReLU, Flatten, Dense 64 + ReLU, Dense 10.  The reference ends in a softmax
+activation; we return logits and fold the softmax into the loss (numerically
+better and fuses on TPU) — predict-time probabilities are exposed by the fit()
+API instead.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        x = x.astype(self.dtype)
+        if x.ndim == 3:  # (B, 28, 28) -> add channel dim
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)

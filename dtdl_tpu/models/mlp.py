@@ -1,0 +1,27 @@
+"""3-layer MLP for MNIST.
+
+Capability parity with the reference Chainer MLP (reference
+chainer/train_mnist.py:13-26: three Linear layers n_units=1000 with ReLU, input
+size inferred, logits out; variant at chainer/train_mnist_multi.py:15-28).
+Flax infers the input width at init the same way Chainer's ``L.Linear(None,..)``
+does.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    n_units: int = 1000
+    n_out: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train  # no train-time-only layers; kept for a uniform signature
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.n_units, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.n_units, dtype=self.dtype)(x))
+        return nn.Dense(self.n_out, dtype=self.dtype)(x).astype(jnp.float32)

@@ -1,0 +1,120 @@
+"""PyramidNet-110 (alpha=270) for CIFAR-10 — the benchmark model.
+
+Capability parity with the reference PyramidNet (reference
+pytorch/model.py:53-118): pre-activation residual blocks
+(BN → conv3x3(stride) → BN → ReLU → conv3x3 → BN), identity shortcuts that
+zero-pad new channels and 2x2 ceil-mode average-pool on downsampling
+(reference pytorch/model.py:6-21), and a linearly growing channel count
+addrate = alpha / (3 * num_layers) with per-block rounding of a fractional
+running width (reference pytorch/model.py:87-97).  Note the reference builds
+``num_layers - 1`` = 17 blocks per stage (the loop at pytorch/model.py:89),
+so 51 blocks total — we match that exactly so parameter counts and loss
+curves are comparable.
+
+TPU-first choices: NHWC layout (channels-last tiles onto the MXU), bfloat16
+compute with float32 params/BN statistics via ``dtype``, kaiming fan-out init
+matching the reference's init loop (pytorch/model.py:79-85).  BatchNorm uses
+per-replica statistics under data parallelism — the same semantics as the
+reference's DDP, which allreduces gradients but not BN stats (SURVEY §7.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+
+
+class IdentityPadding(nn.Module):
+    """Parameter-free shortcut: zero-pad channels, avg-pool on stride 2.
+
+    Mirrors reference pytorch/model.py:6-21 (F.pad on the channel dim + 2x2
+    ceil-mode AvgPool2d).
+    """
+    add_channels: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        if self.add_channels > 0:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, self.add_channels)))
+        if self.stride == 2:
+            # ceil_mode=True: pad odd spatial dims so no edge pixel is dropped
+            h, w = x.shape[1], x.shape[2]
+            x = jnp.pad(x, ((0, 0), (0, h % 2), (0, w % 2), (0, 0)))
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class ResidualBlock(nn.Module):
+    """Pre-act pyramid block: BN-conv-BN-ReLU-conv-BN (+ padded identity).
+
+    Mirrors reference pytorch/model.py:24-50.
+    """
+    in_channels: int
+    out_channels: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=self.dtype)
+        conv = lambda ch, s: nn.Conv(  # noqa: E731
+            ch, (3, 3), strides=(s, s), padding=1, use_bias=False,
+            kernel_init=conv_init, dtype=self.dtype)
+
+        shortcut = IdentityPadding(
+            self.out_channels - self.in_channels, self.stride)(x)
+        out = norm()(x)
+        out = conv(self.out_channels, self.stride)(out)
+        out = norm()(out)
+        out = nn.relu(out)
+        out = conv(self.out_channels, 1)(out)
+        out = norm()(out)
+        return out + shortcut
+
+
+class PyramidNet(nn.Module):
+    """Additive PyramidNet for 32x32 inputs (reference pytorch/model.py:53-112)."""
+    num_layers: int = 18
+    alpha: int = 270
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        addrate = self.alpha / (3.0 * self.num_layers)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype)(x)
+
+        # fractional running width with per-block rounding, 17 blocks/stage
+        in_ch = 16.0
+        for stage_stride in (1, 2, 2):
+            stride = stage_stride
+            for _ in range(self.num_layers - 1):
+                out_ch = in_ch + addrate
+                x = ResidualBlock(int(round(in_ch)), int(round(out_ch)),
+                                  stride, dtype=self.dtype)(x, train=train)
+                in_ch = out_ch
+                stride = 1
+
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))  # global 8x8 avg pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def pyramidnet(dtype=jnp.float32, num_classes: int = 10) -> PyramidNet:
+    """Factory matching reference pytorch/model.py:115-118 (110 layers, a=270)."""
+    return PyramidNet(num_layers=18, alpha=270, num_classes=num_classes,
+                      dtype=dtype)

@@ -1,0 +1,5 @@
+from dtdl_tpu.parallel.strategy import (  # noqa: F401
+    Strategy, SingleDevice, DataParallel, AutoSharded,
+    data_parallel_local, distributed_data_parallel, choose_strategy,
+)
+from dtdl_tpu.parallel import collectives  # noqa: F401

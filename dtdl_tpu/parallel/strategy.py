@@ -1,0 +1,254 @@
+"""Parallelism strategies.
+
+A `Strategy` owns the device mesh and defines three things the train-step
+engine composes with:
+
+* ``grad_sync``   — what happens to gradients before the optimizer update
+* ``compile``     — how a per-replica step function becomes a global SPMD step
+* ``shard_batch`` / ``replicate`` — where batches and parameters live
+
+Mapping to the reference's strategy layer (SURVEY §2.2):
+
+| reference                                             | here                    |
+|-------------------------------------------------------|-------------------------|
+| plain single-device loop (pytorch/single_gpu.py)      | `SingleDevice`          |
+| nn.DataParallel / MirroredStrategy / ParallelUpdater  | `DataParallel(local_mesh())` |
+| DistributedDataParallel / MultiWorkerMirroredStrategy / ChainerMN | `DataParallel(build_mesh())` over a multi-host mesh |
+| (future TP/PP/SP axes)                                | `AutoSharded` with custom rules |
+
+`DataParallel` uses `shard_map` with an explicit `lax.pmean` — the literal
+SPMD restatement of DDP: every replica computes on its local shard of the
+batch with per-replica BatchNorm statistics (matching DDP, which syncs grads
+but not BN batches), gradients are mean-allreduced over ICI, and every replica
+applies an identical update.  Running BN statistics are also pmean-synced so
+the replicated train state stays bitwise identical across replicas (torch DDP
+achieves the same end by broadcasting buffers from rank 0 each step).
+
+`AutoSharded` instead gives XLA's SPMD partitioner the whole step with sharded
+inputs and replicated params — the compiler inserts the AllReduces.  Under it,
+BatchNorm reductions become global-batch (sync-BN semantics).  Both are
+provided; `DataParallel` is the DDP-parity default.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtdl_tpu.runtime.mesh import DATA_AXIS, batch_sharded, build_mesh, local_mesh, replicated
+from dtdl_tpu.parallel import collectives
+
+
+class Strategy:
+    """Base: single logical device semantics."""
+
+    mesh: Mesh | None = None
+    axis: str | None = None
+
+    def localize(self, tree):
+        """Hook: mark replicated values as per-replica before local compute."""
+        return tree
+
+    def grad_sync(self, grads):
+        return grads
+
+    def metric_sync(self, tree):
+        return tree
+
+    def stats_sync(self, tree):
+        return tree
+
+    def compile(self, step_fn, donate_state: bool = True):
+        """Jit a step ``(state, batch, ...) -> (state, metrics)``."""
+        return jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+
+    def compile_eval(self, eval_fn):
+        return jax.jit(eval_fn)
+
+    def compile_predict(self, predict_fn):
+        """Jit an inference fn ``(state, batch) -> outputs`` (batch-aligned)."""
+        return jax.jit(predict_fn)
+
+    def shard_batch(self, batch):
+        return jax.device_put(batch)
+
+    def replicate(self, tree):
+        return jax.device_put(tree)
+
+    @property
+    def num_replicas(self) -> int:
+        return 1
+
+    def per_replica_batch(self, global_batch_size: int) -> int:
+        """Explicit global-vs-per-replica semantics.
+
+        The reference divides the batch by the *local* device count only
+        (reference pytorch/distributed_data_parallel.py:71), which silently
+        changes the global batch as nodes are added; we define --batch-size as
+        GLOBAL and split by the world replica count (SURVEY §2.4).
+        """
+        n = self.num_replicas
+        if global_batch_size % n:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{n} replicas")
+        return global_batch_size // n
+
+
+class SingleDevice(Strategy):
+    """One device, no collectives — reference pytorch/single_gpu.py:43-85."""
+
+
+class MeshStrategy(Strategy):
+    """Shared mesh-bearing behavior: batch/state placement over a mesh."""
+
+    def __init__(self, mesh: Mesh | None = None, axis: str = DATA_AXIS):
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.axis = axis
+
+    def shard_batch(self, batch):
+        """Place a host batch as a global array sharded on the data axis.
+
+        Single-process: device_put scatters local data across the mesh.
+        Multi-process: each host contributes its local shard of the global
+        batch (`make_array_from_process_local_data`) — the deterministic
+        per-host sharding that replaces ``DistributedSampler`` wire-level
+        scatter (reference chainer/train_mnist_multi.py:91-92).
+        """
+        sharding = batch_sharded(self.mesh, self.axis)
+        if jax.process_count() == 1:
+            return jax.device_put(batch, sharding)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            batch)
+
+    def replicate(self, tree):
+        return jax.device_put(tree, replicated(self.mesh))
+
+    @property
+    def num_replicas(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+class DataParallel(MeshStrategy):
+    """shard_map data parallelism over a mesh axis (DP and DDP).
+
+    Single-process over `local_mesh()` ≡ nn.DataParallel/MirroredStrategy;
+    multi-process over `build_mesh()` ≡ DDP/MultiWorkerMirroredStrategy/
+    ChainerMN — same code, the mesh just spans hosts.
+    """
+
+    def localize(self, tree):
+        return collectives.localize(tree, self.axis)
+
+    def grad_sync(self, grads):
+        return collectives.grad_sync(grads, self.axis)
+
+    def metric_sync(self, tree):
+        return collectives.all_reduce_mean(tree, self.axis)
+
+    def stats_sync(self, tree):
+        return collectives.all_reduce_mean(tree, self.axis)
+
+    def compile(self, step_fn, donate_state: bool = True):
+        mapped = jax.shard_map(
+            step_fn, mesh=self.mesh,
+            in_specs=(P(), P(self.axis)),
+            out_specs=(P(), P()),
+        )
+        return jax.jit(mapped, donate_argnums=(0,) if donate_state else ())
+
+    def compile_eval(self, eval_fn):
+        mapped = jax.shard_map(
+            eval_fn, mesh=self.mesh,
+            in_specs=(P(), P(self.axis)),
+            out_specs=P(),
+        )
+        return jax.jit(mapped)
+
+    def compile_predict(self, predict_fn):
+        # outputs stay sharded on the data axis, aligned with the input batch
+        mapped = jax.shard_map(
+            predict_fn, mesh=self.mesh,
+            in_specs=(P(), P(self.axis)),
+            out_specs=P(self.axis),
+        )
+        return jax.jit(mapped)
+
+
+class AutoSharded(MeshStrategy):
+    """Compiler-partitioned strategy (pjit style).
+
+    Params replicated, batch sharded on the data axis; XLA's SPMD partitioner
+    inserts the collectives.  The mesh may carry extra axes (model, pipeline,
+    sequence) — pass ``param_spec`` rules to shard parameters for model
+    parallelism; the data-parallel gradient allreduce still falls out of the
+    partitioner automatically.
+    """
+
+    def __init__(self, mesh: Mesh | None = None, axis: str = DATA_AXIS,
+                 param_spec=None):
+        super().__init__(mesh, axis)
+        self.param_spec = param_spec if param_spec is not None else P()
+
+    def _state_sharding(self):
+        return NamedSharding(self.mesh, self.param_spec)
+
+    def compile(self, step_fn, donate_state: bool = True):
+        state_s = self._state_sharding()
+        batch_s = batch_sharded(self.mesh, self.axis)
+        return jax.jit(
+            step_fn,
+            in_shardings=(state_s, batch_s),
+            out_shardings=(state_s, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    def compile_eval(self, eval_fn):
+        return jax.jit(
+            eval_fn,
+            in_shardings=(self._state_sharding(),
+                          batch_sharded(self.mesh, self.axis)),
+            out_shardings=NamedSharding(self.mesh, P()),
+        )
+
+    def compile_predict(self, predict_fn):
+        return jax.jit(
+            predict_fn,
+            in_shardings=(self._state_sharding(),
+                          batch_sharded(self.mesh, self.axis)),
+            out_shardings=batch_sharded(self.mesh, self.axis),
+        )
+
+    def replicate(self, tree):
+        return jax.device_put(tree, self._state_sharding())
+
+
+def data_parallel_local() -> DataParallel:
+    """Single-process multi-device DP (nn.DataParallel equivalent)."""
+    return DataParallel(local_mesh())
+
+
+def distributed_data_parallel() -> DataParallel:
+    """Global-mesh allreduce DP (DistributedDataParallel equivalent)."""
+    return DataParallel(build_mesh())
+
+
+def choose_strategy(name: str = "auto", mesh: Mesh | None = None) -> Strategy:
+    """Pick a strategy the way the reference picks via script choice.
+
+    'single' | 'dp' | 'ddp' | 'auto' (auto = ddp if >1 device else single).
+    """
+    if name == "auto":
+        name = "ddp" if len(jax.devices()) > 1 else "single"
+    if name == "single":
+        return SingleDevice()
+    if name == "dp":
+        return DataParallel(mesh if mesh is not None else local_mesh())
+    if name == "ddp":
+        return DataParallel(mesh if mesh is not None else build_mesh())
+    if name == "pjit":
+        return AutoSharded(mesh)
+    raise ValueError(f"unknown strategy {name!r}")

@@ -1,0 +1,3 @@
+from dtdl_tpu.runtime.bootstrap import initialize, is_leader, barrier  # noqa: F401
+from dtdl_tpu.runtime.mesh import build_mesh, local_mesh, DATA_AXIS, MODEL_AXIS  # noqa: F401
+from dtdl_tpu.runtime.topology import describe_topology  # noqa: F401

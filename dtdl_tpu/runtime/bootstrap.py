@@ -1,0 +1,85 @@
+"""Process bootstrap and rendezvous.
+
+TPU-native replacement for the reference's three rendezvous mechanisms:
+``dist.init_process_group('nccl', init_method='tcp://...')`` (reference
+pytorch/distributed_data_parallel.py:61-62), the synthesized ``TF_CONFIG``
+cluster spec (reference tensorflow2/mnist_multi_worker_strategy.py:18-25), and
+the MPI communicator (reference chainer/train_mnist_multi.py:49-62).  All
+three collapse onto `jax.distributed.initialize(coordinator, num_processes,
+process_id)`: one process per TPU host, XLA collectives over ICI/DCN instead
+of NCCL/gRPC/MPI.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import socket
+
+import jax
+
+log = logging.getLogger("dtdl_tpu")
+
+_initialized = False
+
+
+def initialize(coordinator: str = "", num_processes: int = 1,
+               process_id: int = 0, local_device_ids=None) -> None:
+    """Join (or create) the multi-process cluster.
+
+    No-op for single-process runs — a plain ``python script.py`` works with no
+    distributed setup, like the reference's single-GPU scripts.  For
+    multi-process, every host calls this with the same coordinator address
+    (host:port of process 0) and its own ``process_id``; it subsumes the
+    reference's rank/world-size/init-method flag trio and TF_CONFIG.
+    """
+    global _initialized
+    if num_processes <= 1 and not coordinator:
+        return
+    if _initialized:
+        return
+    if not coordinator:
+        raise ValueError(
+            "--coordinator host:port is required when --num-processes > 1 "
+            "(the TPU analogue of the reference's --init-method tcp://...)")
+    kwargs = {}
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    log.info("rendezvous: coordinator=%s process %d/%d (host %s)",
+             coordinator, process_id, num_processes, socket.gethostname())
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    _initialized = True
+    atexit.register(_shutdown)
+
+
+def _shutdown() -> None:
+    global _initialized
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # pragma: no cover - best-effort teardown
+            pass
+        _initialized = False
+
+
+def is_leader() -> bool:
+    """True on process 0 — the single writer for checkpoints and logs.
+
+    Standardizes the reference's inconsistent behavior: every DDP rank saved a
+    checkpoint (reference pytorch/distributed_data_parallel.py:103-115, rank-0
+    guard commented out) while ChainerMN gated outputs on rank 0 (reference
+    chainer/train_mnist_multi.py:108-114).  We always gate on the leader.
+    """
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync point (no-op single-process)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)

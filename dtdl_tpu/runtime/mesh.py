@@ -1,0 +1,72 @@
+"""Device-mesh construction.
+
+The mesh is the framework's device model: where the reference binds work to
+devices imperatively (``torch.cuda.set_device`` at reference
+pytorch/distributed_data_parallel.py:64, ``CUDA_VISIBLE_DEVICES`` at reference
+pytorch/data_parallel.py:49-50), we declare a `jax.sharding.Mesh` and let
+shardings place data.  The default mesh puts every addressable device on a
+``data`` axis (pure data parallelism — the reference's only strategy), but the
+axis set is open: pass ``shape``/``axes`` to carve out ``model`` / ``pipeline``
+/ ``sequence`` / ``expert`` axes without redesign.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(shape: tuple[int, ...] | None = None,
+               axes: tuple[str, ...] | None = None,
+               devices=None) -> Mesh:
+    """Build a global mesh over all (or the given) devices.
+
+    With no arguments: a 1-D ``('data',)`` mesh over every addressable device
+    — the TPU equivalent of the reference's allreduce data-parallel world.
+    ``mesh_utils.create_device_mesh`` lays devices out so that neighboring
+    mesh coordinates are ICI neighbors, keeping collectives off DCN wherever
+    the topology allows.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    if axes is None:
+        axes = (DATA_AXIS,) + tuple(
+            f"axis{i}" for i in range(1, len(shape)))
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {int(np.prod(shape))} devices, "
+            f"have {len(devices)}")
+    if len(shape) == 1:
+        dev_array = np.asarray(devices).reshape(shape)
+    else:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(dev_array, axes)
+
+
+def local_mesh(axes: tuple[str, ...] = (DATA_AXIS,)) -> Mesh:
+    """Mesh over this process's local devices only.
+
+    The single-process multi-device world: equivalent of ``nn.DataParallel``
+    (reference pytorch/data_parallel.py:71) / ``MirroredStrategy`` (reference
+    tensorflow2/mnist_mirror_strategy.py:12) / ``ParallelUpdater`` (reference
+    chainer/train_mnist_gpu.py:87-93).
+    """
+    devices = jax.local_devices()
+    return Mesh(np.asarray(devices).reshape((len(devices),)), axes)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding that replicates an array on every mesh device (params)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding that splits an array's leading dim across the data axis."""
+    return NamedSharding(mesh, P(axis))

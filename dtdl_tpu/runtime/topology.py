@@ -1,0 +1,47 @@
+"""Topology discovery and description.
+
+Replaces the reference's ad-hoc device accounting (``torch.cuda.device_count``
+at reference pytorch/distributed_data_parallel.py:54, ``--gpu_nums`` flags)
+with introspection of the JAX device set: chip kind, hosts, per-host device
+count, and — on real TPU slices — the ICI coordinate grid.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def describe_topology() -> dict:
+    devices = jax.devices()
+    local = jax.local_devices()
+    info = {
+        "platform": devices[0].platform,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "num_devices": len(devices),
+        "num_local_devices": len(local),
+        "num_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+    }
+    coords = getattr(devices[0], "coords", None)
+    if coords is not None:
+        info["ici_coords"] = {
+            d.id: tuple(d.coords) for d in devices if hasattr(d, "coords")}
+    return info
+
+
+def banner() -> str:
+    """Human-readable topology banner, printed by the leader at startup.
+
+    The ChainerMN example prints a similar rank-0 banner of run parameters
+    (reference chainer/train_mnist_multi.py:64-73).
+    """
+    t = describe_topology()
+    lines = [
+        "==========================================",
+        f" platform        : {t['platform']} ({t['device_kind']})",
+        f" global devices  : {t['num_devices']}",
+        f" local devices   : {t['num_local_devices']}",
+        f" processes       : {t['num_processes']} (this = {t['process_index']})",
+        "==========================================",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,4 @@
+from dtdl_tpu.train.state import TrainState, init_state  # noqa: F401
+from dtdl_tpu.train.step import (  # noqa: F401
+    make_train_step, make_eval_step, make_predict_step,
+)

@@ -1,0 +1,2 @@
+from dtdl_tpu.utils.random import seed_everything, rng_sequence  # noqa: F401
+from dtdl_tpu.utils.timing import StepTimer, fmt_timedelta  # noqa: F401

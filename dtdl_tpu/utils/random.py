@@ -1,0 +1,36 @@
+"""Deterministic seeding utilities.
+
+The reference parses ``--seed`` flags but never applies them (e.g. the
+reference's pytorch/single_gpu.py:32-33 parses the flag and drops it).  Here
+seeding is real: one call fans a root seed out to numpy, python ``random`` and
+a JAX PRNG key, and `rng_sequence` provides per-step / per-host independent
+streams via `jax.random.fold_in`.
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+
+import jax
+import numpy as np
+
+
+def seed_everything(seed: int) -> jax.Array:
+    """Seed python/numpy RNGs and return a root JAX PRNG key."""
+    _pyrandom.seed(seed)
+    np.random.seed(seed % (2**32))
+    return jax.random.PRNGKey(seed)
+
+
+def rng_sequence(key: jax.Array, *folds: int):
+    """Derive an independent key by folding in integers (step, rank, ...)."""
+    for f in folds:
+        key = jax.random.fold_in(key, f)
+    return key
+
+
+def host_rng(key: jax.Array, process_index: int | None = None) -> jax.Array:
+    """Per-host independent key (for host-local data-order shuffling)."""
+    if process_index is None:
+        process_index = jax.process_index()
+    return jax.random.fold_in(key, process_index)

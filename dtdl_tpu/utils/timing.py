@@ -1,0 +1,58 @@
+"""Wall-clock instrumentation.
+
+The reference hand-rolls per-batch ``time.time()`` deltas and per-epoch
+``datetime.timedelta`` prints in every training loop (reference
+pytorch/distributed_data_parallel.py:122-152).  `StepTimer` is the factored
+equivalent: it tracks batch time, running averages, and epoch elapsed time, and
+knows that under JAX the step is async — it calls ``block_until_ready`` on a
+representative output before reading the clock so timings are honest.
+"""
+
+from __future__ import annotations
+
+import datetime
+import time
+
+
+def fmt_timedelta(seconds: float) -> str:
+    return str(datetime.timedelta(seconds=int(seconds)))
+
+
+class StepTimer:
+    """Tracks per-step wall time and epoch elapsed time."""
+
+    def __init__(self):
+        self.reset_epoch()
+
+    def reset_epoch(self) -> None:
+        self.epoch_start = time.perf_counter()
+        self._step_start = self.epoch_start
+        self.last_step_s = 0.0
+        self.total_steps = 0
+        self._sum_step_s = 0.0
+
+    def step(self, *blockers) -> float:
+        """Mark the end of a step; pass device arrays to block on first."""
+        for b in blockers:
+            try:
+                b.block_until_ready()
+            except AttributeError:
+                pass
+        now = time.perf_counter()
+        self.last_step_s = now - self._step_start
+        self._step_start = now
+        self.total_steps += 1
+        self._sum_step_s += self.last_step_s
+        return self.last_step_s
+
+    @property
+    def avg_step_s(self) -> float:
+        return self._sum_step_s / max(self.total_steps, 1)
+
+    @property
+    def epoch_elapsed_s(self) -> float:
+        return time.perf_counter() - self.epoch_start
+
+    @property
+    def epoch_elapsed(self) -> str:
+        return fmt_timedelta(self.epoch_elapsed_s)

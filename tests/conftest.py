@@ -1,0 +1,33 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+SURVEY §4: the reference has no tests; its CPU fallback paths (``naive``
+communicator, cpu device pick) are the pattern we formalize — every
+distributed code path runs on a fake multi-device CPU backend so DP/DDP
+semantics are checked without a TPU pod.
+
+This environment's sitecustomize imports jax at interpreter start (TPU tunnel
+backend), so env-var overrides are too late — we switch platform through
+jax.config before the backend is first used.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,126 @@
+"""Core engine tests: train step under every strategy.
+
+The key correctness property (SURVEY §4): DDP gradient-psum training on N
+replicas must match single-device training on the same global batch (for
+models without BatchNorm, exactly; with BN, per-replica normalization makes
+them intentionally different — we check convergence instead).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dtdl_tpu.models import MLP, MnistCNN, pyramidnet
+from dtdl_tpu.parallel import DataParallel, SingleDevice, AutoSharded
+from dtdl_tpu.train import init_state, make_train_step, make_eval_step
+
+
+def fake_batch(rng, n, shape, num_classes=10):
+    return {
+        "image": jnp.asarray(rng.normal(size=(n,) + shape), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, num_classes, size=(n,))),
+    }
+
+
+def make_mlp_state(seed=0):
+    model = MLP(n_units=32)
+    tx = optax.sgd(0.1)
+    return init_state(model, jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 784)), tx)
+
+
+def test_single_device_step_runs():
+    state = make_mlp_state()
+    step = make_train_step(SingleDevice())
+    batch = fake_batch(np.random.default_rng(0), 16, (784,))
+    state2, metrics = step(state, batch)
+    assert state2.step == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ddp_matches_single_device(devices):
+    """Grad-psum DP == large-batch single device for a BN-free model."""
+    rng = np.random.default_rng(1)
+    batch = fake_batch(rng, 32, (784,))
+
+    s_state = make_mlp_state()
+    d_state = make_mlp_state()
+    single = make_train_step(SingleDevice())
+    ddp_strategy = DataParallel()
+    assert ddp_strategy.num_replicas == 8
+    ddp = make_train_step(ddp_strategy)
+
+    d_state = ddp_strategy.replicate(d_state)
+    for _ in range(3):
+        s_state, s_metrics = single(s_state, batch)
+        d_state, d_metrics = ddp(d_state, ddp_strategy.shard_batch(batch))
+
+    np.testing.assert_allclose(
+        float(s_metrics["loss"]), float(d_metrics["loss"]), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        s_state.params, jax.device_get(d_state.params))
+
+
+def test_autosharded_matches_single_device(devices):
+    rng = np.random.default_rng(2)
+    batch = fake_batch(rng, 32, (784,))
+    s_state = make_mlp_state()
+    a_state = make_mlp_state()
+    single = make_train_step(SingleDevice())
+    strat = AutoSharded()
+    auto = make_train_step(strat)
+    a_state = strat.replicate(a_state)
+    s_state, sm = single(s_state, batch)
+    a_state, am = auto(a_state, strat.shard_batch(batch))
+    np.testing.assert_allclose(float(sm["loss"]), float(am["loss"]), rtol=1e-5)
+
+
+def test_ddp_state_stays_replicated(devices):
+    """After updates, every replica's params are bitwise identical."""
+    strat = DataParallel()
+    state = strat.replicate(make_mlp_state())
+    step = make_train_step(strat)
+    batch = fake_batch(np.random.default_rng(3), 16, (784,))
+    state, _ = step(state, strat.shard_batch(batch))
+    leaf = jax.tree.leaves(state.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_cnn_with_batchnorm_free_model_eval(devices):
+    strat = DataParallel()
+    model = MnistCNN()
+    state = init_state(model, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 28, 28, 1)), optax.adam(1e-3))
+    state = strat.replicate(state)
+    step = make_train_step(strat)
+    evaluate = make_eval_step(strat)
+    rng = np.random.default_rng(4)
+    batch = fake_batch(rng, 32, (28, 28, 1))
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, strat.shard_batch(batch))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], "loss should decrease on a fixed batch"
+    em = evaluate(state, strat.shard_batch(batch))
+    assert np.isfinite(float(em["loss"]))
+
+
+@pytest.mark.slow
+def test_pyramidnet_ddp_step(devices):
+    """BatchNorm model under shard_map DDP: runs, replicated, loss finite."""
+    model = pyramidnet()
+    strat = DataParallel()
+    state = init_state(model, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 32, 32, 3)), optax.sgd(0.1, momentum=0.9))
+    assert state.batch_stats is not None
+    state = strat.replicate(state)
+    step = make_train_step(strat)
+    batch = fake_batch(np.random.default_rng(5), 16, (32, 32, 3))
+    state, metrics = step(state, strat.shard_batch(batch))
+    assert np.isfinite(float(metrics["loss"]))
