@@ -1,0 +1,159 @@
+"""Checkpoint / resume subsystem.
+
+One subsystem covering the reference's three checkpoint shapes (SURVEY §5.4):
+
+1. **final-weights export** — ``torch.save(net.module.state_dict())`` at end
+   of training (reference pytorch/single_gpu.py:77-85; per-rank DDP variant
+   pytorch/distributed_data_parallel.py:103-115) → `save_weights` /
+   `load_weights` (msgpack of the params pytree);
+2. **per-epoch weight checkpoints + restore-latest** — Keras ``ModelCheckpoint``
+   + ``tf.train.latest_checkpoint`` (reference tensorflow2/mnist_single.py:66-76,
+   88-92) → `Checkpointer.save_weights_epoch` / `Checkpointer.latest_weights`;
+3. **full trainer-state snapshot with resume** — Chainer
+   ``extensions.snapshot()`` + ``serializers.load_npz`` restoring optimizer
+   and iterator state (reference chainer/train_mnist.py:91-93,120-122) →
+   `Checkpointer.save` / `Checkpointer.restore` of the whole `TrainState`
+   (params + opt_state + batch_stats + step) via orbax, which handles
+   sharded/distributed arrays.
+
+Writes are **leader-gated** (process 0) — standardizing the reference's
+inconsistency where every DDP rank wrote a file (the rank-0 guard is
+commented out at reference pytorch/distributed_data_parallel.py:107) while
+ChainerMN gated on rank 0.  Under multi-host sharded states, orbax coordinates
+a distributed write instead (every host writes its shards).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+import numpy as np
+from flax import serialization
+
+from dtdl_tpu.runtime.bootstrap import barrier, is_leader
+
+
+def save_weights(path: str, tree) -> str:
+    """Serialize a (replicated or host-local) pytree of weights to msgpack."""
+    tree = jax.device_get(tree)
+    blob = serialization.to_bytes(tree)
+    if is_leader():
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    barrier("save_weights")
+    return path
+
+
+def load_weights(path: str, like):
+    """Load weights saved by `save_weights` into the structure of ``like``."""
+    with open(path, "rb") as f:
+        return serialization.from_bytes(like, f.read())
+
+
+class Checkpointer:
+    """Directory-managed checkpoints: per-epoch weights + full-state snapshots.
+
+    Layout under ``directory``::
+
+        weights_epoch_0003.msgpack   (shape 2: per-epoch weights)
+        snapshot_12/                 (shape 3: orbax full TrainState at step 12)
+        final.msgpack                (shape 1: final weights export)
+    """
+
+    _WEIGHT_RE = re.compile(r"weights_epoch_(\d+)\.msgpack$")
+    _SNAP_RE = re.compile(r"snapshot_(\d+)$")
+
+    def __init__(self, directory: str, keep: int | None = None):
+        self.directory = directory
+        self.keep = keep
+        if is_leader():
+            os.makedirs(directory, exist_ok=True)
+        barrier("ckpt_mkdir")
+
+    # -- shape 2: per-epoch weights ------------------------------------------
+
+    def save_weights_epoch(self, epoch: int, params) -> str:
+        path = os.path.join(self.directory,
+                            f"weights_epoch_{epoch:04d}.msgpack")
+        save_weights(path, params)
+        self._gc(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack")
+        return path
+
+    def latest_weights(self, like):
+        """Restore-latest (``tf.train.latest_checkpoint`` parity)."""
+        epochs = self._list(self._WEIGHT_RE)
+        if not epochs:
+            return None, None
+        epoch = max(epochs)
+        path = os.path.join(self.directory,
+                            f"weights_epoch_{epoch:04d}.msgpack")
+        return load_weights(path, like), epoch
+
+    # -- shape 3: full trainer-state snapshot --------------------------------
+
+    def save(self, step: int, state) -> str:
+        """Snapshot the full TrainState (optimizer + BN stats + step)."""
+        import orbax.checkpoint as ocp
+        path = os.path.abspath(
+            os.path.join(self.directory, f"snapshot_{step}"))
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, state, force=True)
+        self._gc(self._SNAP_RE, "snapshot_{}")
+        return path
+
+    def restore(self, like, step: int | None = None):
+        """Restore the latest (or given-step) snapshot into ``like``'s shape.
+
+        Returns (state, step) or (None, None) when no snapshot exists — the
+        --resume flow (reference chainer/train_mnist.py:120-122).
+        """
+        steps = self._list(self._SNAP_RE)
+        if not steps:
+            return None, None
+        step = max(steps) if step is None else step
+        path = os.path.abspath(
+            os.path.join(self.directory, f"snapshot_{step}"))
+        import orbax.checkpoint as ocp
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path, like), step
+
+    def restore_path(self, like, path: str):
+        """Restore from an explicit snapshot path (--resume <path>)."""
+        import orbax.checkpoint as ocp
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(os.path.abspath(path), like)
+
+    # -- shape 1: final weights ----------------------------------------------
+
+    def save_final(self, params) -> str:
+        return save_weights(os.path.join(self.directory, "final.msgpack"),
+                            params)
+
+    # -- housekeeping ---------------------------------------------------------
+
+    def _list(self, regex) -> list[int]:
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            m = regex.search(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _gc(self, regex, fmt) -> None:
+        if self.keep is None or not is_leader():
+            return
+        import shutil
+        ids = self._list(regex)
+        for old in ids[:-self.keep]:
+            victim = os.path.join(self.directory, fmt.format(old))
+            if os.path.isdir(victim):
+                shutil.rmtree(victim)
+            elif os.path.exists(victim):
+                os.remove(victim)

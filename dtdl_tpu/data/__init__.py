@@ -1,0 +1,10 @@
+from dtdl_tpu.data.datasets import (  # noqa: F401
+    load_dataset, load_mnist, load_cifar10, normalize_cifar10,
+    CIFAR10_MEAN, CIFAR10_STD,
+)
+from dtdl_tpu.data.sharding import ShardedSampler, scatter_arrays  # noqa: F401
+from dtdl_tpu.data.loader import (  # noqa: F401
+    DataLoader, prefetch_to_device, cifar10_train_transform,
+    normalize_transform,
+)
+from dtdl_tpu.data.idx import read_idx, load_idx_pair  # noqa: F401

@@ -1,0 +1,128 @@
+"""Dataset registry: MNIST, CIFAR-10, synthetic.
+
+One loader covering the reference's three data paths — the Keras npz load
+(reference tensorflow2/mnist_single.py:34-47), the Chainer IDX→npz cache
+(reference chainer/mnist_dataset.py:8-38), and torchvision CIFAR-10 (reference
+pytorch/distributed_data_parallel.py:85-86) — behind a single
+``load_dataset(name, root)`` with a dataset-root flag and a deterministic
+synthetic fallback when files are missing (this replaces the reference's
+hard-coded sibling-path assumptions, SURVEY §2.4).
+
+Returned arrays are always NHWC float32 in [0,1] with int32 labels:
+``(train_images, train_labels), (test_images, test_labels)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+
+import numpy as np
+
+from dtdl_tpu.data import idx, synthetic
+
+log = logging.getLogger("dtdl_tpu")
+
+# standard IDX file names (and the reference's variants)
+_MNIST_FILES = {
+    "train_images": ("train-images-idx3-ubyte.gz", "train-images.idx3-ubyte.gz"),
+    "train_labels": ("train-labels-idx1-ubyte.gz", "train-labels.idx1-ubyte.gz"),
+    "test_images": ("t10k-images-idx3-ubyte.gz", "t10k-images.idx3-ubyte.gz"),
+    "test_labels": ("t10k-labels-idx1-ubyte.gz", "t10k-labels.idx1-ubyte.gz"),
+}
+
+
+def _find(root: str, names) -> str | None:
+    for n in names:
+        for cand in (os.path.join(root, n), os.path.join(root, n[:-3])):
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def load_mnist(root: str = "./datasets", flatten: bool = False):
+    """MNIST from IDX/gz or npz under ``root``/mnist; synthetic fallback."""
+    mdir = os.path.join(root, "mnist")
+    npz = os.path.join(mdir, "mnist.npz")
+    paths = {k: _find(mdir, v) for k, v in _MNIST_FILES.items()}
+    if all(paths.values()):
+        def maker():
+            tr_i, tr_l = idx.load_idx_pair(paths["train_images"],
+                                           paths["train_labels"])
+            te_i, te_l = idx.load_idx_pair(paths["test_images"],
+                                           paths["test_labels"])
+            return {"x_train": tr_i, "y_train": tr_l,
+                    "x_test": te_i, "y_test": te_l}
+        z = idx.cache_npz(os.path.join(mdir, "mnist_cache.npz"), maker)
+        train = (z["x_train"], z["y_train"])
+        test = (z["x_test"], z["y_test"])
+    elif os.path.exists(npz):
+        with np.load(npz) as z:  # keras layout (reference mnist_single.py:36-41)
+            train = (z["x_train"], z["y_train"])
+            test = (z["x_test"], z["y_test"])
+    else:
+        log.warning("MNIST files not found under %s — using deterministic "
+                    "synthetic data", mdir)
+        (tr_i, tr_l), (te_i, te_l) = synthetic.synthetic_mnist()
+        train, test = (tr_i, tr_l), (te_i, te_l)
+
+    def prep(images, labels):
+        images = np.asarray(images, np.float32)
+        if images.max() > 1.5:  # raw 0-255 pixels
+            images = images / 255.0
+        if images.ndim == 3:
+            images = images[..., None]
+        if flatten:
+            images = images.reshape(images.shape[0], -1)
+        return images, np.asarray(labels, np.int32)
+
+    return prep(*train), prep(*test)
+
+
+def load_cifar10(root: str = "./datasets"):
+    """CIFAR-10 from the python pickle batches; synthetic fallback."""
+    cdir = None
+    for cand in ("cifar-10-batches-py", "cifar10", "."):
+        d = os.path.join(root, cand)
+        if os.path.exists(os.path.join(d, "data_batch_1")):
+            cdir = d
+            break
+    if cdir is None:
+        log.warning("CIFAR-10 batches not found under %s — using "
+                    "deterministic synthetic data", root)
+        (tr_i, tr_l), (te_i, te_l) = synthetic.synthetic_cifar10()
+        return (tr_i, tr_l), (te_i, te_l)
+
+    def read_batch(name):
+        with open(os.path.join(cdir, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        images = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return images, np.asarray(d[b"labels"], np.int32)
+
+    parts = [read_batch(f"data_batch_{i}") for i in range(1, 6)]
+    tr_i = np.concatenate([p[0] for p in parts]).astype(np.float32) / 255.0
+    tr_l = np.concatenate([p[1] for p in parts])
+    te_i, te_l = read_batch("test_batch")
+    te_i = te_i.astype(np.float32) / 255.0
+    return (tr_i, tr_l), (te_i, te_l)
+
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def normalize_cifar10(images: np.ndarray) -> np.ndarray:
+    """Channel normalization (reference pytorch/single_gpu.py:51-55 uses the
+    torchvision Normalize transform with the CIFAR-10 statistics)."""
+    return (images - CIFAR10_MEAN) / CIFAR10_STD
+
+
+def load_dataset(name: str, root: str = "./datasets", **kwargs):
+    if name == "mnist":
+        return load_mnist(root, **kwargs)
+    if name == "cifar10":
+        return load_cifar10(root, **kwargs)
+    if name == "synthetic":
+        return synthetic.synthetic_mnist(**kwargs)
+    raise ValueError(f"unknown dataset {name!r}")

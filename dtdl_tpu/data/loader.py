@@ -1,0 +1,124 @@
+"""Host-side batch loader with device prefetch.
+
+The reference feeds devices with torch ``DataLoader(num_workers=4)`` (reference
+pytorch/single_gpu.py:60-61) / Chainer ``SerialIterator`` / Keras ``fit``'s
+internal pipeline.  On TPU the host must keep sub-second steps fed (SURVEY
+§7.3): this loader yields numpy batches from in-memory arrays (optionally
+through a `ShardedSampler`), applies vectorized augmentation on the host, and
+`prefetch_to_device` pipelines H2D transfer so the next global batch is
+already on device when the step finishes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from dtdl_tpu.data.sharding import ShardedSampler
+
+
+class DataLoader:
+    """Minibatch iterator over a dict of equal-length arrays.
+
+    ``batch_size`` is the size of the batches this loader emits — per-host
+    under multi-process DDP (the strategy assembles the global batch), global
+    otherwise.  Deterministic: shuffling derives from (seed, epoch) via the
+    sampler.  ``transform(rng, batch) -> batch`` runs vectorized per batch
+    (augmentation, normalization).
+    """
+
+    def __init__(self, arrays: dict, batch_size: int,
+                 sampler: ShardedSampler | None = None, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True,
+                 transform: Callable | None = None):
+        n = len(next(iter(arrays.values())))
+        for k, v in arrays.items():
+            if len(v) != n:
+                raise ValueError(f"array {k!r} length {len(v)} != {n}")
+        self.arrays = arrays
+        self.batch_size = batch_size
+        self.sampler = sampler or ShardedSampler(n, shuffle=shuffle, seed=seed)
+        self.drop_last = drop_last
+        self.transform = transform
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        self.sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self):
+        idx = np.asarray(self.sampler.indices())
+        rng = np.random.default_rng((self.sampler.seed, self._epoch, 7))
+        n_full = len(idx) // self.batch_size
+        stop = n_full * self.batch_size if self.drop_last else len(idx)
+        for start in range(0, stop, self.batch_size):
+            take = idx[start:start + self.batch_size]
+            batch = {k: v[take] for k, v in self.arrays.items()}
+            if self.transform is not None:
+                batch = self.transform(rng, batch)
+            yield batch
+
+
+def prefetch_to_device(iterator, put: Callable, depth: int = 2):
+    """Pipeline ``put`` (e.g. ``strategy.shard_batch``) ahead of consumption.
+
+    JAX dispatch is async, so issuing the H2D transfer for batch N+1 before
+    batch N's step completes overlaps transfer with compute — the role of
+    torch's ``num_workers`` prefetch (reference pytorch/single_gpu.py:21).
+    """
+    buf = deque()
+    for item in iterator:
+        buf.append(put(item))
+        if len(buf) >= depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
+# ---- augmentation (vectorized host-side transforms) -------------------------
+
+def cifar10_train_transform(mean, std):
+    """Random crop (pad 4) + horizontal flip + normalize, vectorized.
+
+    The reference's torchvision transform stack (reference
+    pytorch/single_gpu.py:51-55: RandomCrop(32, padding=4),
+    RandomHorizontalFlip, ToTensor, Normalize).
+    """
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+
+    def transform(rng, batch):
+        x = batch["image"]
+        b, h, w, c = x.shape
+        padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="constant")
+        ys = rng.integers(0, 9, b)
+        xs = rng.integers(0, 9, b)
+        # gather-based vectorized crop
+        row_idx = ys[:, None] + np.arange(h)[None, :]
+        col_idx = xs[:, None] + np.arange(w)[None, :]
+        out = padded[np.arange(b)[:, None, None], row_idx[:, :, None],
+                     col_idx[:, None, :], :]
+        flip = rng.random(b) < 0.5
+        out[flip] = out[flip, :, ::-1, :]
+        out = (out - mean) / std
+        return {**batch, "image": out.astype(np.float32)}
+
+    return transform
+
+
+def normalize_transform(mean, std):
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+
+    def transform(rng, batch):
+        del rng
+        x = (batch["image"] - mean) / std
+        return {**batch, "image": x.astype(np.float32)}
+
+    return transform

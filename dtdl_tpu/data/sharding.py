@@ -1,0 +1,99 @@
+"""Deterministic dataset sharding across processes.
+
+TPU-native restatement of the reference's two sharding mechanisms:
+
+* ``DistributedSampler`` — per-rank index slices of a shared dataset with a
+  per-epoch shuffle (reference pytorch/distributed_data_parallel.py:87-91,
+  including ``set_epoch`` semantics);
+* ``chainermn.scatter_dataset`` — rank 0 loads, shards are scattered over MPI
+  (reference chainer/train_mnist_multi.py:87-92).
+
+On TPU hosts every process can read the dataset source directly, so scatter
+becomes *deterministic per-host slicing* — same partition, no wire transfer:
+every host computes the same global permutation from (seed, epoch) and takes
+its own contiguous stripe.  With remainder handling made explicit: ``pad``
+wraps indices so all shards are equal (DistributedSampler's behavior), while
+``drop`` truncates to the largest even multiple.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ShardedSampler:
+    """Per-process view of a globally shuffled index space."""
+
+    def __init__(self, num_examples: int, num_shards: int = 1,
+                 shard_id: int = 0, shuffle: bool = True, seed: int = 0,
+                 remainder: str = "pad"):
+        if not 0 <= shard_id < num_shards:
+            raise ValueError(f"shard_id {shard_id} not in [0, {num_shards})")
+        if remainder not in ("pad", "drop"):
+            raise ValueError("remainder must be 'pad' or 'drop'")
+        self.num_examples = num_examples
+        self.num_shards = num_shards
+        self.shard_id = shard_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.remainder = remainder
+        self.epoch = 0
+        if remainder == "pad":
+            self.shard_size = -(-num_examples // num_shards)
+        else:
+            self.shard_size = num_examples // num_shards
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle deterministically per epoch (DistributedSampler parity:
+        the reference calls train_sampler.set_epoch implicitly by epoch count)."""
+        self.epoch = epoch
+
+    def global_permutation(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            perm = rng.permutation(self.num_examples)
+        else:
+            perm = np.arange(self.num_examples)
+        total = self.shard_size * self.num_shards
+        if self.remainder == "pad" and total > self.num_examples:
+            perm = np.concatenate([perm, perm[: total - self.num_examples]])
+        else:
+            perm = perm[:total]
+        return perm
+
+    def indices(self) -> np.ndarray:
+        """This shard's indices for the current epoch (contiguous stripe)."""
+        perm = self.global_permutation()
+        start = self.shard_id * self.shard_size
+        return perm[start:start + self.shard_size]
+
+    def __iter__(self):
+        return iter(self.indices())
+
+    def __len__(self) -> int:
+        return self.shard_size
+
+
+def scatter_arrays(arrays: dict, num_shards: int, shard_id: int,
+                   shuffle: bool = True, seed: int = 0) -> dict:
+    """Materialize this process's shard of a dict of arrays.
+
+    Functional equivalent of ``chainermn.scatter_dataset(..., shuffle=True)``
+    (reference chainer/train_mnist_multi.py:91-92) without the wire transfer:
+    all hosts derive the same permutation, each keeps only its stripe.
+    """
+    n = len(next(iter(arrays.values())))
+    sampler = ShardedSampler(n, num_shards, shard_id, shuffle=shuffle,
+                             seed=seed, remainder="drop")
+    idx = sampler.indices()
+    return {k: v[idx] for k, v in arrays.items()}
+
+
+def assert_no_overlap(samplers) -> None:
+    """Test helper: shards must partition the index space (no overlap)."""
+    seen = set()
+    for s in samplers:
+        ix = set(int(i) for i in s.indices())
+        if seen & ix and s.remainder == "drop":
+            raise AssertionError("overlapping shards")
+        seen |= ix

@@ -1,0 +1,3 @@
+from dtdl_tpu.metrics.report import (  # noqa: F401
+    Reporter, Accumulator, StdoutSink, JsonlSink, TensorBoardSink,
+)

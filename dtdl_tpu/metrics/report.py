@@ -1,0 +1,150 @@
+"""Metrics bus: one reporter, pluggable sinks, leader-gated.
+
+Unifies the reference's three observability styles (SURVEY §5.5):
+fixed-format rank-prefixed stdout prints every 20 steps (reference
+pytorch/distributed_data_parallel.py:144-148, ``flush=True``), Chainer's JSON
+``LogReport`` + ``PrintReport`` table (reference chainer/train_mnist.py:89-115),
+and TF2's TensorBoard event files (reference
+tensorflow2/mnist_multi_worker_strategy.py:80).  Distributed runs gate output
+on the leader the way ChainerMN gates extensions on rank 0 (reference
+chainer/train_mnist_multi.py:106-114).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from dtdl_tpu.runtime.bootstrap import is_leader
+
+
+class Accumulator:
+    """Running means of scalar metrics over an epoch (Chainer-report style)."""
+
+    def __init__(self):
+        self._sums: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+
+    def add(self, metrics: dict, weight: int = 1) -> None:
+        for k, v in metrics.items():
+            v = float(v)
+            self._sums[k] = self._sums.get(k, 0.0) + v * weight
+            self._counts[k] = self._counts.get(k, 0) + weight
+
+    def means(self) -> dict:
+        return {k: self._sums[k] / self._counts[k] for k in self._sums}
+
+    def reset(self) -> None:
+        self._sums.clear()
+        self._counts.clear()
+
+
+class StdoutSink:
+    """Fixed-format prints matching the reference's per-batch log line
+    (loss / acc / batch time, reference pytorch/distributed_data_parallel.py:144-148)."""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+
+    def write(self, payload: dict) -> None:
+        parts = []
+        if "epoch" in payload:
+            parts.append(f"Epoch [{payload['epoch']}]")
+        if "step" in payload and "steps_per_epoch" in payload:
+            parts.append(f"[{payload['step']}/{payload['steps_per_epoch']}]")
+        elif "step" in payload:
+            parts.append(f"step {payload['step']}")
+        for k, v in payload.items():
+            if k in ("epoch", "step", "steps_per_epoch", "split"):
+                continue
+            if isinstance(v, float):
+                parts.append(f"{k}: {v:.4f}" if abs(v) < 100 else f"{k}: {v:.2f}")
+            else:
+                parts.append(f"{k}: {v}")
+        line = (self.prefix + " " if self.prefix else "") + " | ".join(parts)
+        print(line, flush=True)
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink:
+    """JSON-lines log file (Chainer ``LogReport`` parity — the reference
+    writes a JSON log under the trainer out dir, chainer/train_mnist.py:103)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._f = open(path, "a", buffering=1)
+        self._t0 = time.time()
+
+    def write(self, payload: dict) -> None:
+        rec = dict(payload)
+        rec.setdefault("elapsed_time", round(time.time() - self._t0, 3))
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class TensorBoardSink:
+    """TensorBoard event files when a writer implementation is importable.
+
+    TF2-track parity (reference tensorflow2/mnist_single.py:72-76).  Degrades
+    to a no-op with a one-time warning when no tensorboard package exists —
+    this environment has none, and the metrics bus must not hard-depend on it.
+    """
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self._writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # type: ignore
+            self._writer = SummaryWriter(logdir)
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+                self._writer = SummaryWriter(logdir)
+            except Exception:
+                import logging
+                logging.getLogger("dtdl_tpu").warning(
+                    "no tensorboard writer available; TensorBoardSink is a "
+                    "no-op (metrics still go to stdout/JSONL sinks)")
+
+    def write(self, payload: dict) -> None:
+        if self._writer is None:
+            return
+        step = int(payload.get("step", 0))
+        split = payload.get("split", "train")
+        for k, v in payload.items():
+            if isinstance(v, float):
+                self._writer.add_scalar(f"{split}/{k}", v, step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+class Reporter:
+    """Fan-out of metric payloads to sinks; silent on non-leader processes."""
+
+    def __init__(self, sinks=None, leader_only: bool = True):
+        self.sinks = list(sinks) if sinks is not None else [StdoutSink()]
+        self.leader_only = leader_only
+
+    @property
+    def active(self) -> bool:
+        return not self.leader_only or is_leader()
+
+    def report(self, payload: dict) -> None:
+        if not self.active:
+            return
+        clean = {k: (float(v) if hasattr(v, "item") else v)
+                 for k, v in payload.items()}
+        for sink in self.sinks:
+            sink.write(clean)
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            sink.close()

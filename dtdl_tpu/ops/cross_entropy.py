@@ -27,7 +27,9 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
         return losses.mean()
     if reduction == "sum":
         return losses.sum()
-    return losses
+    if reduction == "none":
+        return losses
+    raise ValueError(f"unknown reduction {reduction!r}")
 
 
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:

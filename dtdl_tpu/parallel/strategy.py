@@ -57,6 +57,10 @@ class Strategy:
     def metric_sync(self, tree):
         return tree
 
+    def sum_sync(self, tree):
+        """Sum-allreduce (for exact count-weighted eval metrics)."""
+        return tree
+
     def stats_sync(self, tree):
         return tree
 
@@ -148,6 +152,9 @@ class DataParallel(MeshStrategy):
 
     def metric_sync(self, tree):
         return collectives.all_reduce_mean(tree, self.axis)
+
+    def sum_sync(self, tree):
+        return collectives.all_reduce_sum(tree, self.axis)
 
     def stats_sync(self, tree):
         return collectives.all_reduce_mean(tree, self.axis)

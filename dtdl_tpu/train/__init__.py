@@ -2,3 +2,11 @@ from dtdl_tpu.train.state import TrainState, init_state  # noqa: F401
 from dtdl_tpu.train.step import (  # noqa: F401
     make_train_step, make_eval_step, make_predict_step,
 )
+from dtdl_tpu.train.loop import train_epoch, evaluate  # noqa: F401
+from dtdl_tpu.train.trainer import (  # noqa: F401
+    Trainer, Trigger, Extension, Evaluator, LogReport, PrintReport,
+    ProgressSummary, snapshot, dump_graph,
+)
+from dtdl_tpu.train.fit import (  # noqa: F401
+    Model, Callback, History, ModelCheckpoint, TensorBoard, PrintLR,
+)

@@ -1,0 +1,282 @@
+"""compile()/fit() — the Keras-style API flavor.
+
+Capability parity with the TF2 track (reference
+tensorflow2/mnist_single.py:65-92): build+compile a model (under a strategy —
+the reference does it inside ``strategy.scope()``,
+mnist_mirror_strategy.py:68-73), ``fit(x, y, batch_size, epochs,
+validation_data, callbacks)`` with a History, per-epoch `ModelCheckpoint`,
+`TensorBoard` callback, and restore-latest + evaluate (reference
+mnist_single.py:88-92).  In JAX the "scope" is the strategy object itself —
+pass it at construction; parameters are created replicated/sharded per the
+strategy, no context manager needed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dtdl_tpu.ckpt.checkpoint import Checkpointer, load_weights, save_weights
+from dtdl_tpu.data.loader import DataLoader, prefetch_to_device
+from dtdl_tpu.metrics.report import Accumulator, Reporter, StdoutSink, TensorBoardSink
+from dtdl_tpu.parallel.strategy import SingleDevice, Strategy
+from dtdl_tpu.train.state import init_state
+from dtdl_tpu.train.step import make_eval_step, make_predict_step, make_train_step
+
+
+class Callback:
+    def set_model(self, model: "Model") -> None:
+        self.model = model
+
+    def on_train_begin(self) -> None: ...
+    def on_epoch_begin(self, epoch: int) -> None: ...
+    def on_epoch_end(self, epoch: int, logs: dict) -> None: ...
+    def on_train_end(self) -> None: ...
+
+
+class History(Callback):
+    def on_train_begin(self) -> None:
+        self.history: dict[str, list] = {}
+
+    def on_epoch_end(self, epoch: int, logs: dict) -> None:
+        for k, v in logs.items():
+            self.history.setdefault(k, []).append(v)
+
+
+class ModelCheckpoint(Callback):
+    """Per-epoch checkpoints (reference tensorflow2/mnist_single.py:66-76
+    saves ``ckpt_{epoch}`` weights every epoch).
+
+    ``save_weights_only=False`` snapshots the full TrainState (optimizer
+    slots, BN stats, step) instead of just the params.
+    """
+
+    def __init__(self, directory: str, save_weights_only: bool = True,
+                 keep: int | None = None):
+        self.ckpt = Checkpointer(directory, keep=keep)
+        self.save_weights_only = save_weights_only
+
+    def on_epoch_end(self, epoch: int, logs: dict) -> None:
+        if self.save_weights_only:
+            self.ckpt.save_weights_epoch(epoch, self.model.state.params)
+        else:
+            self.ckpt.save(epoch, self.model.state)
+
+
+class TensorBoard(Callback):
+    """TensorBoard events when available (reference mnist_single.py:72-73)."""
+
+    def __init__(self, log_dir: str):
+        self.sink = TensorBoardSink(log_dir)
+
+    def on_epoch_end(self, epoch: int, logs: dict) -> None:
+        self.sink.write({"step": epoch, "split": "epoch", **logs})
+
+    def on_train_end(self) -> None:
+        self.sink.close()
+
+
+class PrintLR(Callback):
+    """Parity with the reference's (unused) PrintLR callback
+    (tensorflow2/mnist_single.py:50-56)."""
+
+    def __init__(self, schedule_or_value):
+        self.lr = schedule_or_value
+
+    def on_epoch_end(self, epoch: int, logs: dict) -> None:
+        lr = self.lr(self.model.state.step) if callable(self.lr) else self.lr
+        print(f"\nLearning rate for epoch {epoch + 1} is {float(lr)}",
+              flush=True)
+
+
+_OPTIMIZERS = {
+    "adam": lambda: optax.adam(1e-3),
+    "sgd": lambda: optax.sgd(1e-2),
+    "rmsprop": lambda: optax.rmsprop(1e-3),
+}
+
+
+class Model:
+    """Keras-flavored wrapper around a flax module + strategy."""
+
+    def __init__(self, module, strategy: Strategy | None = None):
+        self.module = module
+        self.strategy = strategy or SingleDevice()
+        self.state = None
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+
+    def compile(self, optimizer="adam", loss: str | None = None,
+                metrics: Sequence[str] = ("accuracy",), seed: int = 0,
+                example_input=None) -> "Model":
+        """Build params (replicated per strategy) and the compiled steps.
+
+        ``loss`` accepts 'sparse_categorical_crossentropy' (the reference's
+        choice, tensorflow2/mnist_single.py:86-87) or None for the same.
+        """
+        if loss not in (None, "sparse_categorical_crossentropy"):
+            raise ValueError(f"unsupported loss {loss!r}")
+        if isinstance(optimizer, str):
+            tx = _OPTIMIZERS[optimizer.lower()]()
+        else:
+            tx = optimizer
+        self._tx = tx
+        self._seed = seed
+        self._example_input = example_input
+        self._train_step = make_train_step(self.strategy)
+        self._eval_step = make_eval_step(self.strategy)
+        self._predict_step = make_predict_step(self.strategy,
+                                               probabilities=True)
+        return self
+
+    def _ensure_state(self, x) -> None:
+        if self.state is not None:
+            return
+        example = self._example_input
+        if example is None:
+            example = jnp.zeros((1,) + tuple(x.shape[1:]), jnp.float32)
+        self.state = self.strategy.replicate(init_state(
+            self.module, jax.random.PRNGKey(self._seed), example, self._tx))
+
+    def _loader(self, x, y, batch_size: int, shuffle: bool, seed: int,
+                drop_last: bool = True) -> DataLoader:
+        """Per-host loader: under multi-process each host reads only its
+        stripe of the global permutation and feeds ``batch_size/num_hosts``
+        rows — the strategy assembles the global batch.  Without this every
+        host would feed identical rows and the global batch would duplicate
+        each example process_count times."""
+        nproc = jax.process_count()
+        if batch_size % max(nproc, 1):
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by {nproc} processes")
+        from dtdl_tpu.data.sharding import ShardedSampler
+        sampler = ShardedSampler(len(y), nproc, jax.process_index(),
+                                 shuffle=shuffle, seed=seed)
+        return DataLoader({"image": x, "label": y}, batch_size // nproc,
+                          sampler=sampler, drop_last=drop_last)
+
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
+            validation_data=None, callbacks: Sequence[Callback] = (),
+            shuffle: bool = True, seed: int = 0, verbose: int = 1) -> History:
+        x = np.asarray(x)
+        y = np.asarray(y)
+        self._ensure_state(x)
+        history = History()
+        cbs = [history, *callbacks]
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_train_begin()
+        reporter = Reporter([StdoutSink()]) if verbose else None
+        loader = self._loader(x, y, batch_size, shuffle, seed)
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            loader.set_epoch(epoch)
+            acc = Accumulator()
+            it = prefetch_to_device(iter(loader), self.strategy.shard_batch)
+            for batch in it:
+                self.state, metrics = self._train_step(self.state, batch)
+                acc.add({k: float(v) for k, v in metrics.items()})
+            logs = acc.means()
+            if validation_data is not None:
+                vx, vy = validation_data
+                val = self.evaluate(vx, vy, batch_size=batch_size, verbose=0)
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            if reporter is not None:
+                reporter.report({"epoch": epoch, **logs})
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+        for cb in cbs:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, x, y, batch_size: int = 32, verbose: int = 1) -> dict:
+        """Exact full-dataset metrics (ragged tails masked, never dropped)."""
+        from dtdl_tpu.train.loop import evaluate as _evaluate
+        x = np.asarray(x)
+        y = np.asarray(y)
+        self._ensure_state(x)
+        loader = self._loader(x, y, batch_size, shuffle=False, seed=0,
+                              drop_last=False)
+        means = _evaluate(self._eval_step, self.state, loader, self.strategy)
+        if verbose:
+            print(" - ".join(f"{k}: {v:.4f}" for k, v in means.items()),
+                  flush=True)
+        return means
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        """Class probabilities (the reference model ends in softmax).
+
+        Multi-process: each host computes its stripe; results are
+        all-gathered so every host returns the full, ordered output.
+        """
+        x = np.asarray(x)
+        self._ensure_state(x)
+        n = len(x)
+        nproc = jax.process_count()
+        if nproc > 1:
+            # contiguous equal stripes (padded at the end), gathered below
+            stripe = -(-n // nproc)
+            lo = jax.process_index() * stripe
+            local = x[lo:lo + stripe]
+            if len(local) < stripe:  # tail host pads
+                pad_rows = np.repeat(x[-1:], stripe - len(local), axis=0)
+                local = np.concatenate([local, pad_rows]) if len(local) \
+                    else pad_rows
+        else:
+            local = x
+        outs = []
+        per_host_bs = max(batch_size // max(nproc, 1), 1)
+        for start in range(0, len(local), per_host_bs):
+            xb = local[start:start + per_host_bs]
+            pad = 0
+            if len(xb) < per_host_bs:
+                pad = per_host_bs - len(xb)
+                xb = np.concatenate([xb, xb[-1:].repeat(pad, axis=0)])
+            batch = self.strategy.shard_batch(
+                {"image": jnp.asarray(xb),
+                 "label": jnp.zeros((len(xb),), jnp.int32)})
+            probs = self._predict_step(self.state, batch)
+            probs = np.concatenate(
+                [np.asarray(s.data) for s in sorted(
+                    probs.addressable_shards, key=lambda s: s.index[0].start
+                    if s.index and s.index[0].start is not None else 0)]) \
+                if nproc > 1 else np.asarray(probs)
+            outs.append(probs[:per_host_bs - pad] if pad else probs)
+        local_out = np.concatenate(outs)
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(local_out)
+            return gathered.reshape(-1, gathered.shape[-1])[:n]
+        return local_out[:n]
+
+    # -- weights io -----------------------------------------------------------
+
+    def save_weights(self, path: str) -> None:
+        save_weights(path, self.state.params)
+
+    def load_weights(self, path: str) -> None:
+        if self.state is None:
+            raise ValueError("call fit/evaluate once (or compile with "
+                             "example_input) before load_weights")
+        params = load_weights(path, jax.device_get(self.state.params))
+        self.state = self.state.replace(
+            params=self.strategy.replicate(params))
+
+    def load_latest(self, directory: str) -> bool:
+        """Restore-latest-then-evaluate flow (reference mnist_single.py:88-92)."""
+        ckpt = Checkpointer(directory)
+        if self.state is None:
+            raise ValueError("state not initialized yet")
+        params, epoch = ckpt.latest_weights(jax.device_get(self.state.params))
+        if params is None:
+            return False
+        self.state = self.state.replace(
+            params=self.strategy.replicate(params))
+        return True

@@ -1,0 +1,98 @@
+"""Imperative training loop — the torch-style API flavor.
+
+The reference's hand-written epoch/step loops (reference
+pytorch/single_gpu.py:88-120 and pytorch/distributed_data_parallel.py:118-152:
+forward, loss, backward, step, log every 20 batches with loss / running acc /
+batch time).  Here the per-step math lives in the compiled step function;
+this module is the thin host loop around it: feed sharded batches (with
+prefetch), tick the timer honestly (blocking on a metric), and report.
+
+Users who want full control write this loop themselves — these helpers are
+the canonical version the examples share.
+"""
+
+from __future__ import annotations
+
+from dtdl_tpu.data.loader import prefetch_to_device
+from dtdl_tpu.metrics.report import Accumulator, Reporter
+from dtdl_tpu.parallel.strategy import Strategy
+from dtdl_tpu.utils.timing import StepTimer
+
+
+def train_epoch(train_step, state, loader, strategy: Strategy,
+                reporter: Reporter | None = None, epoch: int = 0,
+                log_interval: int = 20, timer: StepTimer | None = None,
+                prefetch: int = 2):
+    """Run one epoch; returns (state, epoch_mean_metrics)."""
+    timer = timer or StepTimer()
+    timer.reset_epoch()
+    acc = Accumulator()
+    loader.set_epoch(epoch)
+    steps_per_epoch = len(loader)
+    it = prefetch_to_device(iter(loader), strategy.shard_batch, prefetch)
+    for i, batch in enumerate(it):
+        state, metrics = train_step(state, batch)
+        timer.step(metrics["loss"])
+        acc.add({k: float(v) for k, v in metrics.items()})
+        if reporter is not None and (i % log_interval) == 0:
+            reporter.report({
+                "epoch": epoch, "step": i,
+                "steps_per_epoch": steps_per_epoch,
+                **{k: float(v) for k, v in metrics.items()},
+                "batch_time": timer.last_step_s,
+            })
+    if reporter is not None:
+        reporter.report({
+            "epoch": epoch, "split": "train_epoch",
+            **acc.means(),
+            "epoch_time": timer.epoch_elapsed_s,
+            "avg_batch_time": timer.avg_step_s,
+        })
+    return state, acc.means()
+
+
+def _pad_and_mask(batch, target: int):
+    """Pad a ragged tail batch to ``target`` rows, masking the padding.
+
+    Keeps batch shapes static (one compiled eval program) and keeps metrics
+    exact: the eval step ignores mask=0 rows.
+    """
+    import numpy as np
+    n = len(next(iter(batch.values())))
+    mask = np.ones(n, np.float32)
+    if n == target:
+        return {**batch, "mask": mask}
+    pad = target - n
+    out = {k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+           for k, v in batch.items()}
+    out["mask"] = np.concatenate([mask, np.zeros(pad, np.float32)])
+    return out
+
+
+def evaluate(eval_step, state, loader, strategy: Strategy,
+             reporter: Reporter | None = None, epoch: int = 0,
+             prefetch: int = 2):
+    """Full-dataset evaluation; returns exact global mean metrics.
+
+    Handles ragged tail batches (DataLoader(drop_last=False)) by padding to
+    the loader's batch size with masked rows — every real example counts
+    exactly once, unlike the reference's silently-dropped or double-counted
+    tails.
+    """
+    target = loader.batch_size
+    it = prefetch_to_device(
+        (_pad_and_mask(b, target) for b in iter(loader)),
+        strategy.shard_batch, prefetch)
+    sums = {"loss_sum": 0.0, "correct_sum": 0.0, "count": 0.0}
+    for batch in it:
+        metrics = eval_step(state, batch)
+        for k in sums:
+            sums[k] += float(metrics[k])
+    if sums["count"] == 0:
+        return {"loss": float("nan"), "accuracy": float("nan")}
+    means = {"loss": sums["loss_sum"] / sums["count"],
+             "accuracy": sums["correct_sum"] / sums["count"]}
+    if reporter is not None:
+        reporter.report({"epoch": epoch, "split": "val",
+                         **{f"val_{k}": v for k, v in means.items()}})
+    return means

@@ -76,19 +76,31 @@ def make_train_step(strategy: Strategy | None = None,
 
 def make_eval_step(strategy: Strategy | None = None,
                    loss_fn: Callable = softmax_cross_entropy):
-    """Build the compiled eval step ``(state, batch) -> metrics``.
+    """Build the compiled eval step ``(state, batch) -> summed metrics``.
 
-    Uses running BN statistics (train=False).  Metrics are globally averaged —
+    Uses running BN statistics (train=False).  Returns **sums**, not means:
+    ``{"loss_sum", "correct_sum", "count"}``, sum-allreduced across the mesh —
     the multi-node evaluator shape (reference chainer/train_mnist_multi.py:101-104
-    allreduces eval metrics the same way).
+    allreduces eval metrics the same way).  Sum semantics make ragged tail
+    batches exact: callers pad the batch to a shardable size and mark padding
+    with ``batch["mask"] = 0``; masked examples contribute nothing.  Divide by
+    ``count`` at the end (`dtdl_tpu.train.loop.evaluate` does this).
     """
     strategy = strategy or SingleDevice()
 
     def evaluate(state: TrainState, batch):
         logits, _ = _forward(state, state.params, batch, train=False)
-        return strategy.metric_sync({
-            "loss": loss_fn(logits, batch["label"]),
-            "accuracy": accuracy(logits, batch["label"]),
+        labels = batch["label"]
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(labels.shape, jnp.float32)
+        mask = mask.astype(jnp.float32)
+        losses = loss_fn(logits, labels, reduction="none")
+        correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        return strategy.sum_sync({
+            "loss_sum": (losses * mask).sum(),
+            "correct_sum": (correct * mask).sum(),
+            "count": mask.sum(),
         })
 
     return strategy.compile_eval(evaluate)

@@ -1,0 +1,207 @@
+"""The three API flavors: imperative loop, Keras-style fit, Chainer-style Trainer."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dtdl_tpu.data import DataLoader
+from dtdl_tpu.data.synthetic import class_pattern_images
+from dtdl_tpu.metrics import Reporter, JsonlSink, StdoutSink
+from dtdl_tpu.models import MLP
+from dtdl_tpu.parallel import DataParallel, SingleDevice
+from dtdl_tpu.train import (
+    Evaluator, LogReport, Model, ModelCheckpoint, PrintReport, Trainer,
+    evaluate, init_state, make_eval_step, make_train_step, snapshot,
+    train_epoch, dump_graph,
+)
+
+
+def small_data(n=256, seed=0):
+    """Train/val must share class patterns: one pool, slice off the tail."""
+    x, y = class_pattern_images(n + 128, (784,), 10, seed, noise=0.1)
+    return (x[:n], y[:n]), (x[n:], y[n:])
+
+
+def mk(units=64, lr=0.05, strategy=None, seed=0):
+    strategy = strategy or SingleDevice()
+    state = init_state(MLP(n_units=units), jax.random.PRNGKey(seed),
+                       jnp.zeros((1, 784)), optax.sgd(lr, momentum=0.9))
+    return strategy.replicate(state), strategy
+
+
+# ---- imperative loop --------------------------------------------------------
+
+def test_imperative_loop_converges(devices, capsys):
+    (x, y), _ = small_data()
+    strat = DataParallel()
+    state, _ = mk(strategy=strat)
+    step = make_train_step(strat)
+    ev = make_eval_step(strat)
+    loader = DataLoader({"image": x, "label": y}, batch_size=64, seed=0)
+    reporter = Reporter([StdoutSink()])
+    for epoch in range(3):
+        state, means = train_epoch(step, state, loader, strat,
+                                   reporter=reporter, epoch=epoch,
+                                   log_interval=2)
+    val = evaluate(ev, state, loader, strat, reporter=reporter)
+    assert val["accuracy"] > 0.9, val
+    out = capsys.readouterr().out
+    assert "batch_time" in out and "Epoch [0]" in out
+
+
+# ---- fit() ------------------------------------------------------------------
+
+def test_fit_history_validation_and_checkpoint(tmp_path, devices):
+    (x, y), (vx, vy) = small_data()
+    model = Model(MLP(n_units=64), DataParallel())
+    model.compile(optimizer=optax.sgd(0.05, momentum=0.9))
+    hist = model.fit(x, y, batch_size=64, epochs=3,
+                     validation_data=(vx, vy),
+                     callbacks=[ModelCheckpoint(str(tmp_path / "ck"))],
+                     verbose=0)
+    assert len(hist.history["loss"]) == 3
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    assert "val_accuracy" in hist.history
+    assert os.path.exists(tmp_path / "ck" / "weights_epoch_0002.msgpack")
+
+    # restore-latest then evaluate (reference mnist_single.py:88-92 flow)
+    model2 = Model(MLP(n_units=64), DataParallel())
+    model2.compile(optimizer=optax.sgd(0.05),
+                   example_input=jnp.zeros((1, 784)))
+    model2._ensure_state(x)
+    assert model2.load_latest(str(tmp_path / "ck"))
+    res = model2.evaluate(vx, vy, batch_size=64, verbose=0)
+    assert res["accuracy"] > 0.8
+
+    probs = model2.predict(x[:100], batch_size=64)
+    assert probs.shape == (100, 10)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_fit_rejects_unknown_loss():
+    model = Model(MLP(n_units=8))
+    with pytest.raises(ValueError, match="unsupported loss"):
+        model.compile(loss="mse")
+
+
+# ---- Trainer ----------------------------------------------------------------
+
+def test_trainer_extensions_and_log(tmp_path, devices, capsys):
+    (x, y), _ = small_data()
+    strat = DataParallel()
+    state, _ = mk(strategy=strat)
+    step = make_train_step(strat)
+    loader = DataLoader({"image": x, "label": y}, batch_size=64, seed=0)
+    vloader = DataLoader({"image": x[:128], "label": y[:128]}, batch_size=64,
+                         shuffle=False)
+    trainer = Trainer(state, step, loader, strat, stop_trigger=(3, "epoch"),
+                      out=str(tmp_path / "result"))
+    log = LogReport()
+    trainer.extend(Evaluator(make_eval_step(strat), vloader, strat))
+    trainer.extend(log)
+    trainer.extend(PrintReport(["epoch", "iteration", "loss", "accuracy",
+                                "val_loss", "val_accuracy", "elapsed_time"],
+                               log))
+    trainer.extend(dump_graph({"image": x[:64], "label": y[:64]}))
+    trainer.run()
+    assert trainer.epoch == 3
+    assert len(log.records) == 3
+    assert log.records[-1]["loss"] < log.records[0]["loss"]
+    assert "val_accuracy" in log.records[-1]
+    assert os.path.exists(tmp_path / "result" / "log.jsonl")
+    with open(tmp_path / "result" / "log.jsonl") as f:
+        lines = [json.loads(l) for l in f]
+    assert len(lines) == 3
+    assert os.path.exists(tmp_path / "result" / "train_step.hlo.txt")
+    out = capsys.readouterr().out
+    assert "val_accuracy" in out  # PrintReport header
+
+
+def test_trainer_midepoch_snapshot_resume(tmp_path, devices):
+    """Iteration-triggered snapshot mid-epoch resumes the exact remainder."""
+    (x, y), _ = small_data()  # 256 examples, bs 64 -> 4 batches/epoch
+    strat = DataParallel()
+    step = make_train_step(strat)
+
+    def build(out, stop):
+        state, _ = mk(strategy=strat)
+        loader = DataLoader({"image": x, "label": y}, batch_size=64, seed=0)
+        return Trainer(state, step, loader, strat, stop_trigger=stop, out=out)
+
+    t_ref = build(str(tmp_path / "a"), (10, "iteration"))
+    t_ref.run()
+    ref_params = jax.device_get(t_ref.state.params)
+
+    t1 = build(str(tmp_path / "b"), (6, "iteration"))  # stops mid-epoch 2
+    t1.extend(snapshot(), trigger=(6, "iteration"))
+    t1.run()
+    assert t1.iteration == 6 and t1.epoch == 1 and t1.iteration_in_epoch == 2
+
+    t2 = build(str(tmp_path / "b"), (10, "iteration"))
+    assert t2.resume()
+    assert t2.iteration == 6 and t2._skip_batches == 2
+    t2.run()
+    assert t2.iteration == 10
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+        ref_params, jax.device_get(t2.state.params))
+
+
+def test_evaluate_ragged_tail_exact(devices):
+    """103 examples, bs 64: masked padding makes metrics exact."""
+    (x, y), _ = small_data()
+    x, y = x[:103], y[:103]
+    strat = DataParallel()
+    state, _ = mk(strategy=strat)
+    ev = make_eval_step(strat)
+    loader = DataLoader({"image": x, "label": y}, batch_size=64,
+                        shuffle=False, drop_last=False)
+    out = evaluate(ev, state, loader, strat)
+    # exact reference: single-device full-batch eval
+    sstate, sstrat = mk()
+    sev = make_eval_step(sstrat)
+    m = sev(sstate, {"image": jnp.asarray(x), "label": jnp.asarray(y)})
+    np.testing.assert_allclose(out["loss"],
+                               float(m["loss_sum"]) / 103, rtol=1e-5)
+    np.testing.assert_allclose(out["accuracy"],
+                               float(m["correct_sum"]) / 103, rtol=1e-6)
+
+
+def test_trainer_snapshot_resume(tmp_path, devices):
+    """Chainer --resume flow: stop mid-run, resume, end equivalently."""
+    (x, y), _ = small_data()
+    strat = DataParallel()
+    step = make_train_step(strat)
+
+    def build(out):
+        state, _ = mk(strategy=strat)
+        loader = DataLoader({"image": x, "label": y}, batch_size=64, seed=0)
+        return Trainer(state, step, loader, strat,
+                       stop_trigger=(4, "epoch"), out=out)
+
+    # uninterrupted reference run
+    t_ref = build(str(tmp_path / "a"))
+    t_ref.run()
+    ref_params = jax.device_get(t_ref.state.params)
+
+    # interrupted run: 2 epochs, snapshot, fresh trainer resumes
+    t1 = build(str(tmp_path / "b"))
+    t1.stop = type(t1.stop)(2, "epoch")
+    t1.extend(snapshot(), trigger=(2, "epoch"))
+    t1.run()
+
+    t2 = build(str(tmp_path / "b"))
+    assert t2.resume()
+    assert t2.epoch == 2
+    t2.run()
+    assert t2.epoch == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+        ref_params, jax.device_get(t2.state.params))

@@ -1,0 +1,129 @@
+"""Data pipeline tests: IDX parser, sharding partition properties, loader."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from dtdl_tpu.data import (
+    DataLoader, ShardedSampler, load_dataset, scatter_arrays,
+    cifar10_train_transform, CIFAR10_MEAN, CIFAR10_STD,
+)
+from dtdl_tpu.data.idx import read_idx
+from dtdl_tpu.data.sharding import assert_no_overlap
+
+
+def write_idx(path, array, dtype_code=0x08):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, dtype_code, array.ndim))
+        f.write(struct.pack(">" + "I" * array.ndim, *array.shape))
+        f.write(array.astype(np.uint8).tobytes())
+
+
+def test_idx_roundtrip(tmp_path):
+    arr = (np.arange(3 * 5 * 4) % 251).astype(np.uint8).reshape(3, 5, 4)
+    p = str(tmp_path / "x.idx3-ubyte.gz")
+    write_idx(p, arr)
+    out = read_idx(p)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = str(tmp_path / "bad.gz")
+    with gzip.open(p, "wb") as f:
+        f.write(b"\x12\x34\x56\x78hello")
+    with pytest.raises(ValueError, match="not an IDX file"):
+        read_idx(p)
+
+
+def test_mnist_idx_loading(tmp_path):
+    """Full MNIST path through real IDX files (tiny synthetic ones)."""
+    mdir = tmp_path / "mnist"
+    mdir.mkdir()
+    rng = np.random.default_rng(0)
+    tri = rng.integers(0, 255, (20, 28, 28)).astype(np.uint8)
+    trl = rng.integers(0, 10, (20,)).astype(np.uint8)
+    tei = rng.integers(0, 255, (8, 28, 28)).astype(np.uint8)
+    tel = rng.integers(0, 10, (8,)).astype(np.uint8)
+    write_idx(str(mdir / "train-images-idx3-ubyte.gz"), tri)
+    write_idx(str(mdir / "train-labels-idx1-ubyte.gz"), trl)
+    write_idx(str(mdir / "t10k-images-idx3-ubyte.gz"), tei)
+    write_idx(str(mdir / "t10k-labels-idx1-ubyte.gz"), tel)
+    (xtr, ytr), (xte, yte) = load_dataset("mnist", str(tmp_path))
+    assert xtr.shape == (20, 28, 28, 1) and xtr.dtype == np.float32
+    assert xtr.max() <= 1.0
+    np.testing.assert_array_equal(ytr, trl.astype(np.int32))
+    assert xte.shape == (8, 28, 28, 1)
+    np.testing.assert_array_equal(yte, tel.astype(np.int32))
+    # cache hit path
+    (xtr2, _), _ = load_dataset("mnist", str(tmp_path))
+    np.testing.assert_array_equal(xtr, xtr2)
+
+
+def test_synthetic_fallback(tmp_path):
+    (xtr, ytr), (xte, yte) = load_dataset("mnist", str(tmp_path / "nope"))
+    assert xtr.shape == (60000, 28, 28, 1)
+    assert set(np.unique(ytr)) == set(range(10))
+
+
+def test_sharded_sampler_partitions():
+    n, shards = 103, 8
+    samplers = [ShardedSampler(n, shards, i, seed=3) for i in range(shards)]
+    sizes = {len(s) for s in samplers}
+    assert sizes == {13}  # padded to equal shards
+    all_idx = np.concatenate([s.indices() for s in samplers])
+    assert len(all_idx) == 13 * 8
+    assert set(all_idx.tolist()) == set(range(n))  # covers everything
+
+
+def test_sharded_sampler_drop_no_overlap():
+    samplers = [ShardedSampler(103, 8, i, seed=3, remainder="drop")
+                for i in range(8)]
+    assert_no_overlap(samplers)
+    all_idx = np.concatenate([s.indices() for s in samplers])
+    assert len(set(all_idx.tolist())) == len(all_idx)
+
+
+def test_sampler_epoch_reshuffle_deterministic():
+    a = ShardedSampler(100, 4, 2, seed=7)
+    a.set_epoch(0)
+    e0 = a.indices().copy()
+    a.set_epoch(1)
+    e1 = a.indices().copy()
+    assert not np.array_equal(e0, e1)
+    a.set_epoch(0)
+    np.testing.assert_array_equal(a.indices(), e0)
+
+
+def test_scatter_arrays_parity():
+    data = {"x": np.arange(50), "y": np.arange(50) * 2}
+    shards = [scatter_arrays(data, 4, i, seed=1) for i in range(4)]
+    seen = np.concatenate([s["x"] for s in shards])
+    assert len(seen) == 48  # drop remainder
+    assert len(set(seen.tolist())) == 48
+    for s in shards:
+        np.testing.assert_array_equal(s["y"], s["x"] * 2)
+
+
+def test_dataloader_batches_and_transform():
+    n = 37
+    data = {"image": np.random.default_rng(0).normal(
+        size=(n, 32, 32, 3)).astype(np.float32),
+        "label": np.arange(n, dtype=np.int32)}
+    dl = DataLoader(data, batch_size=8, seed=5,
+                    transform=cifar10_train_transform(CIFAR10_MEAN, CIFAR10_STD))
+    batches = list(dl)
+    assert len(batches) == 4  # drop_last
+    assert batches[0]["image"].shape == (8, 32, 32, 3)
+    # deterministic across re-iteration of same epoch
+    again = list(dl)
+    np.testing.assert_array_equal(batches[0]["label"], again[0]["label"])
+    dl.set_epoch(1)
+    nxt = list(dl)
+    assert not np.array_equal(batches[0]["label"], nxt[0]["label"])
+
+
+def test_dataloader_rejects_ragged():
+    with pytest.raises(ValueError, match="length"):
+        DataLoader({"a": np.zeros(3), "b": np.zeros(4)}, batch_size=2)

@@ -108,7 +108,8 @@ def test_cnn_with_batchnorm_free_model_eval(devices):
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0], "loss should decrease on a fixed batch"
     em = evaluate(state, strat.shard_batch(batch))
-    assert np.isfinite(float(em["loss"]))
+    assert np.isfinite(float(em["loss_sum"]))
+    assert float(em["count"]) == 32
 
 
 @pytest.mark.slow
