@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Benchmark: PyramidNet-110(a=270) CIFAR-10 training throughput.
+
+The reference's headline workload and numbers (reference pytorch/README.md:
+41-43,128): PyramidNet-110 alpha=270, batch 64, Tesla P100 — 0.255 s/batch =
+251 samples/sec on one GPU.  This script times the same global-batch-64
+training step on whatever devices JAX exposes (the one TPU chip here) and
+prints ONE JSON line:
+
+    {"metric": "...", "value": N, "unit": "samples/sec", "vs_baseline": N}
+
+vs_baseline > 1.0 means faster than the reference's single-P100 batch time.
+Honest timing: warmup steps first (compile + autotune), then blocking timing
+of a fixed step count with data already on device.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_SAMPLES_PER_SEC = 64 / 0.255  # reference pytorch/README.md:41 (P100)
+
+
+def main(batch_size: int = 64, warmup: int = 10, iters: int = 50) -> dict:
+    from dtdl_tpu.models import pyramidnet
+    from dtdl_tpu.parallel import choose_strategy
+    from dtdl_tpu.train import init_state, make_train_step
+
+    strategy = choose_strategy("auto")
+    model = pyramidnet(dtype=jnp.bfloat16)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=False)
+    state = strategy.replicate(init_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), tx))
+    step = make_train_step(strategy)
+
+    rng = np.random.default_rng(0)
+    # a handful of distinct on-device batches so no lucky caching occurs
+    batches = [strategy.shard_batch({
+        "image": jnp.asarray(rng.normal(size=(batch_size, 32, 32, 3)),
+                             jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, batch_size)),
+    }) for _ in range(4)]
+
+    for i in range(warmup):
+        state, metrics = step(state, batches[i % len(batches)])
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, metrics = step(state, batches[i % len(batches)])
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch_size * iters / dt
+    result = {
+        "metric": "pyramidnet110_cifar10_train_samples_per_sec_bs64",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,10 @@ class DataLoader:
         self.drop_last = drop_last
         self.transform = transform
         self._epoch = 0
+        if len(self) == 0:
+            raise ValueError(
+                f"0 batches: {len(self.sampler)} examples with batch_size "
+                f"{batch_size}" + (" and drop_last=True" if drop_last else ""))
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch

@@ -1,0 +1,1 @@
+from dtdl_tpu.launch.local import launch_local  # noqa: F401

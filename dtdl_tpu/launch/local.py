@@ -1,0 +1,126 @@
+"""Local multi-process launcher.
+
+The TPU analogue of ``torch.multiprocessing.spawn`` (reference
+pytorch/distributed_data_parallel.py:53-56) and the reference's manual
+one-shell-per-rank launch procedure (reference pytorch/README.md:69-113,
+which literally asks the user to open four terminals): spawn N processes of a
+training script on this host, each told the shared coordinator address and
+its process id, with rank-prefixed log streaming and fail-fast on a dead rank
+(the reference's jobs simply hang when a rank dies — SURVEY §5.3).
+
+Used both for real multi-host-style testing on CPU (each process gets its own
+device set via JAX_PLATFORMS=cpu) and as the per-host process starter the
+TPU-VM launcher invokes.
+
+CLI:  python -m dtdl_tpu.launch.local --nproc 2 [--port 12355] -- script.py --flags
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+
+def launch_local(script_args: list[str], nproc: int = 2, port: int = 12355,
+                 env_extra: dict | None = None, timeout: float = 600.0,
+                 devices_per_proc: int | None = None) -> int:
+    """Spawn ``nproc`` processes of a script; non-zero if any rank failed.
+
+    Each child receives ``--coordinator 127.0.0.1:port --num-processes nproc
+    --process-id i`` appended to its argv (the script is expected to pass
+    them to `dtdl_tpu.runtime.initialize`).  Output is streamed line-by-line
+    with a ``[rank i]`` prefix (the reference prints rank-prefixed lines from
+    each DDP worker, pytorch/distributed_data_parallel.py:144-148).  If any
+    process dies — non-zero exit *or* a signal — the rest are terminated and
+    the dying rank's code is returned: fail fast instead of the reference's
+    silent hang.
+    """
+    procs: list[subprocess.Popen] = []
+    coordinator = f"127.0.0.1:{port}"
+    for i in range(nproc):
+        env = dict(os.environ)
+        if env_extra:
+            env.update(env_extra)
+        if devices_per_proc is not None:
+            # carve CPU devices per process for single-host rendezvous tests
+            env["JAX_PLATFORMS"] = "cpu"
+            # an axon/TPU sitecustomize (if present) must not claim the chip
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{devices_per_proc}").strip()
+        cmd = [sys.executable, *script_args,
+               "--coordinator", coordinator,
+               "--num-processes", str(nproc),
+               "--process-id", str(i)]
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, bufsize=1))
+
+    def pump(i: int, p: subprocess.Popen):
+        for line in p.stdout:  # blocking per-thread read; no buffer stalls
+            print(f"[rank {i}] {line}", end="", flush=True)
+
+    threads = [threading.Thread(target=pump, args=(i, p), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+
+    deadline = time.time() + timeout
+    first_failure = 0
+    failed = False
+    while any(p.poll() is None for p in procs):
+        if time.time() > deadline:
+            print(f"[launcher] timeout after {timeout}s; killing", flush=True)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            first_failure = first_failure or 124
+            break
+        for i, p in enumerate(procs):
+            rc = p.poll()
+            if rc is not None and rc != 0 and not failed:
+                failed = True
+                first_failure = rc
+                print(f"[launcher] rank {i} exited with {rc}; "
+                      "terminating remaining ranks", flush=True)
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+        time.sleep(0.2)
+    rcs = [p.wait() for p in procs]
+    for t in threads:
+        t.join(timeout=5)
+    if first_failure:
+        return first_failure
+    return next((rc for rc in rcs if rc != 0), 0)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    nproc, port, devices = 2, 12355, None
+    while argv and argv[0] != "--":
+        if argv[0] == "--nproc":
+            nproc = int(argv[1]); argv = argv[2:]
+        elif argv[0] == "--port":
+            port = int(argv[1]); argv = argv[2:]
+        elif argv[0] == "--devices-per-proc":
+            devices = int(argv[1]); argv = argv[2:]
+        else:
+            raise SystemExit(f"unknown launcher flag {argv[0]} "
+                             "(use: --nproc N --port P -- script.py ...)")
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        raise SystemExit("no script given; usage: "
+                         "python -m dtdl_tpu.launch.local --nproc 2 -- script.py")
+    return launch_local(argv, nproc=nproc, port=port,
+                        devices_per_proc=devices)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

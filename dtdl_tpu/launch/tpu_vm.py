@@ -1,0 +1,142 @@
+"""TPU-VM slice launcher.
+
+Replaces the reference's cluster launch mechanisms — the advertised-but-absent
+SLURM script (reference README.md:11; no such file exists in the tree), the
+manual four-shells-on-two-nodes procedure (reference pytorch/README.md:96-113),
+and TF_CONFIG host lists (reference tensorflow2/mnist_multi_worker_strategy.py:18-25)
+— with a TPU-native one: enumerate the slice's worker hosts, start one
+process per host with the coordinator address (worker 0) and its process id,
+stream logs rank-prefixed, and fail fast when a worker dies.
+
+Host discovery order:
+1. explicit ``--workers h1,h2,...``
+2. ``TPU_WORKER_HOSTNAMES`` (set by the TPU runtime on TPU VMs)
+3. single localhost (degenerate 1-host slice)
+
+Remote execution uses plain ``ssh`` by default or ``gcloud compute tpus
+tpu-vm ssh --worker=i`` with ``--gcloud NAME``.  ``--dry-run`` prints the
+exact per-worker commands without executing — usable (and tested) in
+environments without a pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import threading
+import time
+
+
+def discover_workers(explicit: str = "") -> list[str]:
+    if explicit:
+        return [w.strip() for w in explicit.split(",") if w.strip()]
+    env = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if env:
+        return [w.strip() for w in env.split(",") if w.strip()]
+    return ["localhost"]
+
+
+def build_commands(workers: list[str], script_args: list[str],
+                   port: int = 8476, gcloud_name: str = "",
+                   zone: str = "") -> list[list[str]]:
+    """Per-worker command lines (worker 0's host is the coordinator)."""
+    coordinator = f"{workers[0]}:{port}"
+    cmds = []
+    for i, host in enumerate(workers):
+        payload = [
+            "python3", *script_args,
+            "--coordinator", coordinator,
+            "--num-processes", str(len(workers)),
+            "--process-id", str(i),
+        ]
+        if len(workers) == 1 and host in ("localhost", "127.0.0.1"):
+            cmds.append([sys.executable, *payload[1:]])
+        elif gcloud_name:
+            remote = " ".join(shlex.quote(a) for a in payload)
+            cmds.append([
+                "gcloud", "compute", "tpus", "tpu-vm", "ssh", gcloud_name,
+                *(["--zone", zone] if zone else []),
+                f"--worker={i}", "--command", remote])
+        else:
+            remote = " ".join(shlex.quote(a) for a in payload)
+            cmds.append(["ssh", "-o", "BatchMode=yes", host, remote])
+    return cmds
+
+
+def run(workers: list[str], cmds: list[list[str]],
+        poll_interval: float = 2.0) -> int:
+    """Start all workers, stream rank-prefixed logs, fail fast on death.
+
+    The reference's static world hangs forever when a rank dies (SURVEY
+    §5.3); here a non-zero worker exit terminates the remaining workers with
+    a clear error naming the dead host.
+    """
+    procs: list[subprocess.Popen] = []
+    for cmd in cmds:
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, bufsize=1))
+
+    def pump(i: int, p: subprocess.Popen):
+        for line in p.stdout:
+            print(f"[worker {i} {workers[i]}] {line}", end="", flush=True)
+
+    threads = [threading.Thread(target=pump, args=(i, p), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+
+    rc = 0
+    failed = False
+    while any(p.poll() is None for p in procs):
+        for i, p in enumerate(procs):
+            code = p.poll()
+            if code is not None and code != 0 and not failed:
+                failed = True
+                rc = code  # preserve the ORIGINAL failing worker's code
+                print(f"[launcher] FATAL: worker {i} ({workers[i]}) exited "
+                      f"with {code}; terminating slice job", flush=True)
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+        time.sleep(poll_interval)
+    rcs = [p.wait() for p in procs]
+    for t in threads:
+        t.join(timeout=5)
+    return rc or next((c for c in rcs if c != 0), 0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Launch a training script across a TPU-VM slice")
+    parser.add_argument("--workers", default="",
+                        help="comma-separated worker hosts (default: "
+                             "TPU_WORKER_HOSTNAMES or localhost)")
+    parser.add_argument("--port", type=int, default=8476,
+                        help="coordinator port on worker 0")
+    parser.add_argument("--gcloud", default="",
+                        help="TPU name to ssh via gcloud instead of raw ssh")
+    parser.add_argument("--zone", default="")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print per-worker commands and exit")
+    parser.add_argument("script", nargs=argparse.REMAINDER,
+                        help="-- script.py --flags")
+    args = parser.parse_args(argv)
+    script = args.script[1:] if args.script[:1] == ["--"] else args.script
+    if not script:
+        parser.error("no training script given (append: -- script.py --flags)")
+    workers = discover_workers(args.workers)
+    cmds = build_commands(workers, script, args.port, args.gcloud, args.zone)
+    if args.dry_run:
+        for i, cmd in enumerate(cmds):
+            print(f"[worker {i} {workers[i]}] "
+                  + " ".join(shlex.quote(c) for c in cmd))
+        return 0
+    return run(workers, cmds)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

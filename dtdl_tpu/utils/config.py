@@ -65,6 +65,11 @@ def add_data_flags(parser, dataset="mnist"):
               "data is generated deterministically when files are absent")
     flag(parser, "--num-workers", type=int, default=0,
          help="host-side prefetch depth (0 = synchronous)")
+    flag(parser, "--limit-train", type=int, default=0,
+         help="truncate the train set to N examples (0 = full); for smoke "
+              "tests and demos")
+    flag(parser, "--limit-test", type=int, default=0,
+         help="truncate the test set to N examples (0 = full)")
 
 
 def add_ckpt_flags(parser, out="./result"):

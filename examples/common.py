@@ -1,0 +1,107 @@
+"""Shared plumbing for the example scripts.
+
+The reference duplicates its CLI/data/model blocks in every script (SURVEY
+§2.4 notes the three identical TF2 Net/DataSet copies); the examples here
+factor that into one module and keep each script focused on the distributed
+idiom it demonstrates.  Flag names mirror the reference scripts, both
+spellings accepted (dtdl_tpu.utils.config).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import optax
+
+from dtdl_tpu.data import (
+    CIFAR10_MEAN, CIFAR10_STD, DataLoader, ShardedSampler,
+    cifar10_train_transform, load_dataset, normalize_transform,
+)
+from dtdl_tpu.runtime import initialize, is_leader
+from dtdl_tpu.runtime.topology import banner
+from dtdl_tpu.utils.config import parse_mesh_shape
+
+
+def bootstrap(args):
+    """Rendezvous (if multi-process) and print the leader banner."""
+    initialize(coordinator=getattr(args, "coordinator", ""),
+               num_processes=getattr(args, "num_processes", 1),
+               process_id=getattr(args, "process_id", 0))
+    if is_leader():
+        print(banner(), flush=True)
+
+
+def build_mesh_from_args(args):
+    from dtdl_tpu.runtime import build_mesh
+    spec = parse_mesh_shape(args)
+    if spec is None:
+        return build_mesh()
+    shape, axes = spec
+    return build_mesh(shape, axes)
+
+
+def per_process_loader(images, labels, global_batch: int, *, shuffle: bool,
+                       seed: int, transform=None, drop_last: bool = True):
+    """Loader feeding this host's stripe of the global batch."""
+    nproc = jax.process_count()
+    if global_batch % nproc:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{nproc} processes")
+    sampler = ShardedSampler(len(labels), nproc, jax.process_index(),
+                             shuffle=shuffle, seed=seed)
+    return DataLoader({"image": images, "label": labels},
+                      global_batch // nproc, sampler=sampler,
+                      drop_last=drop_last, transform=transform)
+
+
+def _limit(args, train, test):
+    (xtr, ytr), (xte, yte) = train, test
+    for name in ("limit_train", "limit_test"):
+        if getattr(args, name, 0) < 0:
+            raise ValueError(f"--{name.replace('_', '-')} must be >= 0")
+    if getattr(args, "limit_train", 0):
+        xtr, ytr = xtr[: args.limit_train], ytr[: args.limit_train]
+    if getattr(args, "limit_test", 0):
+        xte, yte = xte[: args.limit_test], yte[: args.limit_test]
+    return (xtr, ytr), (xte, yte)
+
+
+def cifar_loaders(args, seed: int):
+    """CIFAR-10 train/val loaders with the reference's augmentation
+    (RandomCrop(32, pad 4) + flip + normalize, reference
+    pytorch/single_gpu.py:51-55)."""
+    (xtr, ytr), (xte, yte) = _limit(
+        args, *load_dataset("cifar10", args.dataset_dir))
+    train = per_process_loader(
+        xtr, ytr, args.batch_size, shuffle=True, seed=seed,
+        transform=cifar10_train_transform(CIFAR10_MEAN, CIFAR10_STD))
+    val = per_process_loader(
+        xte, yte, args.batch_size, shuffle=False, seed=seed,
+        transform=normalize_transform(CIFAR10_MEAN, CIFAR10_STD),
+        drop_last=False)
+    return train, val
+
+
+def mnist_arrays(args, flatten: bool = False):
+    return _limit(args, *load_dataset("mnist", args.dataset_dir,
+                                      flatten=flatten))
+
+
+def sgd_steplr(lr: float, momentum: float, weight_decay: float,
+               steps_per_epoch: int, step_epochs: int = 2,
+               gamma: float = 0.1):
+    """SGD + StepLR(step=2 epochs, gamma=0.1) — the reference DDP optimizer
+    (reference pytorch/distributed_data_parallel.py:94-97)."""
+    schedule = optax.exponential_decay(
+        lr, transition_steps=step_epochs * steps_per_epoch,
+        decay_rate=gamma, staircase=True)
+    tx = optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(schedule, momentum=momentum),
+    )
+    return tx, schedule

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Multi-process / multi-host allreduce data parallelism — the flagship path.
+
+Capability parity with reference pytorch/distributed_data_parallel.py (the
+repo's centerpiece): multi-process rendezvous, per-process device binding,
+gradient allreduce, per-rank dataset sharding, per-rank batch division, SGD +
+StepLR(2, 0.1), checkpoint at the end.  TPU-native restatement:
+
+* ``--init-method tcp://host:port`` + ``--rank/--world-size`` →
+  ``--coordinator host:port --process-id --num-processes`` into
+  `jax.distributed.initialize` (both spellings accepted);
+* NCCL bucketed allreduce from ``loss.backward()`` (reference :132) → XLA
+  AllReduce over ICI emitted by `lax.pmean` inside the jitted step;
+* ``DistributedSampler`` (reference :87-91) → `ShardedSampler` per-host
+  stripes of a deterministic global permutation;
+* per-*local*-device batch division (reference :71 — subtly wrong across
+  nodes) → explicit GLOBAL batch split across all replicas;
+* every-rank checkpoint writes (reference :103-115) → leader-only write.
+
+Launch (2 hosts):
+    python -m dtdl_tpu.launch.tpu_vm --workers h1,h2 -- \
+        examples/distributed_data_parallel.py --batch-size 256
+or manually per host, mirroring the reference's shell-per-rank procedure:
+    python examples/distributed_data_parallel.py \
+        --coordinator h1:8476 --num-processes 2 --process-id 0|1
+"""
+
+import jax
+import jax.numpy as jnp
+
+from common import bootstrap, build_mesh_from_args, cifar_loaders, sgd_steplr
+from dtdl_tpu.ckpt import Checkpointer
+from dtdl_tpu.metrics import JsonlSink, Reporter, StdoutSink
+from dtdl_tpu.models import pyramidnet
+from dtdl_tpu.parallel import DataParallel
+from dtdl_tpu.runtime import is_leader
+from dtdl_tpu.train import evaluate, init_state, make_eval_step, \
+    make_train_step, train_epoch
+from dtdl_tpu.utils import seed_everything
+from dtdl_tpu.utils.config import (add_ckpt_flags, add_data_flags,
+                                   add_topology_flags, add_train_flags,
+                                   flag, make_parser)
+
+
+def main():
+    parser = make_parser("dtdl_tpu: multi-host allreduce DDP CIFAR-10")
+    add_train_flags(parser, batch_size=64, lr=0.1, epochs=20)
+    add_data_flags(parser, dataset="cifar10")
+    add_ckpt_flags(parser)
+    add_topology_flags(parser)
+    flag(parser, "--dist-backend", default="ici",
+         help="accepted for parity (reference defaults to 'nccl'); "
+              "collectives always ride ICI/DCN via XLA here")
+    flag(parser, "--dtype", default="bfloat16",
+         choices=["float32", "bfloat16"])
+    args = parser.parse_args()
+
+    bootstrap(args)  # rendezvous: jax.distributed.initialize
+    key = seed_everything(args.seed)
+    strategy = DataParallel(build_mesh_from_args(args))
+    if is_leader():
+        print(f"DDP over {strategy.num_replicas} replicas on "
+              f"{jax.process_count()} process(es); global batch "
+              f"{args.batch_size} -> "
+              f"{strategy.per_replica_batch(args.batch_size)}/replica",
+              flush=True)
+
+    train_loader, val_loader = cifar_loaders(args, args.seed)
+    tx, schedule = sgd_steplr(args.lr, args.momentum, args.weight_decay,
+                              len(train_loader))
+    model = pyramidnet(dtype=jnp.dtype(args.dtype))
+    state = strategy.replicate(
+        init_state(model, key, jnp.zeros((1, 32, 32, 3)), tx))
+
+    step = make_train_step(strategy)
+    eval_step = make_eval_step(strategy)
+    sinks = [StdoutSink(prefix=f"[p{jax.process_index()}]")]
+    if is_leader():
+        sinks.append(JsonlSink(f"{args.out}/log.jsonl"))
+    reporter = Reporter(sinks)
+    for epoch in range(args.epochs):
+        state, _ = train_epoch(step, state, train_loader, strategy,
+                               reporter=reporter, epoch=epoch,
+                               log_interval=args.log_interval)
+        evaluate(eval_step, state, val_loader, strategy,
+                 reporter=reporter, epoch=epoch)
+    if args.save_model:
+        ckpt = Checkpointer(args.out)
+        path = ckpt.save_final(state.params)
+        if is_leader():
+            print(f"leader saved weights to {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""MNIST CNN under single-host multi-device data parallelism, fit() API.
+
+Capability parity with reference tensorflow2/mnist_mirror_strategy.py:
+``MirroredStrategy`` becomes a `DataParallel` strategy over the local mesh;
+model build + compile happen against the strategy object (the reference does
+it inside ``strategy.scope()``, :68-73 — JAX needs no context manager: the
+strategy places parameters when they are created).
+
+    python examples/mnist_mirror_strategy.py --batch_size 64 --epochs 2
+"""
+
+from common import bootstrap
+from dtdl_tpu.parallel import data_parallel_local
+from dtdl_tpu.utils.config import add_data_flags, make_parser
+
+from mnist_single import add_tf2_flags, run
+
+
+def main():
+    parser = make_parser("dtdl_tpu: Keras-style MNIST CNN (MirroredStrategy)")
+    add_tf2_flags(parser)
+    add_data_flags(parser, dataset="mnist")
+    args = parser.parse_args()
+    bootstrap(args)
+    strategy = data_parallel_local()  # all local devices, like MirroredStrategy
+    print(f"Mirrored DP over {strategy.num_replicas} local device(s)",
+          flush=True)
+    run(args, strategy)
+
+
+if __name__ == "__main__":
+    main()

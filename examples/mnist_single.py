@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""MNIST CNN with the Keras-style compile/fit API, single process.
+
+Capability parity with reference tensorflow2/mnist_single.py: build the
+3-conv CNN, ``fit`` with TensorBoard + per-epoch checkpoint callbacks,
+then restore the latest checkpoint and evaluate (reference :65-92).
+Flag names match the reference's argparse block (:97-115).
+
+    python examples/mnist_single.py --batch_size 64 --epochs 2
+"""
+
+import jax.numpy as jnp
+import optax
+
+from common import bootstrap, mnist_arrays
+from dtdl_tpu.models import MnistCNN
+from dtdl_tpu.parallel import SingleDevice
+from dtdl_tpu.train import Model, ModelCheckpoint, TensorBoard
+from dtdl_tpu.utils import seed_everything
+from dtdl_tpu.utils.config import add_data_flags, flag, make_parser
+
+
+def add_tf2_flags(parser):
+    """The reference's flag surface (tensorflow2/mnist_single.py:97-115)."""
+    flag(parser, "--train_dir", "-td", type=str, default="./train_dir")
+    flag(parser, "--batch_size", "-b", type=int, default=64)
+    flag(parser, "--test_batchsize", "-tb", type=int, default=1000)
+    flag(parser, "--epochs", "-e", type=int, default=10)
+    flag(parser, "--gpu_nums", "-g", type=int, default=0)
+    flag(parser, "--cpu_nums", "-c", type=int, default=0)
+    flag(parser, "--learning_rate", "-lr", type=float, default=0.01)
+    flag(parser, "--momentum", type=float, default=0.5)
+    flag(parser, "--log_interval", type=int, default=10)
+    flag(parser, "--save_model", "-sm", action="store_true", default=False)
+    flag(parser, "--seed", type=int, default=0)
+
+
+def run(args, strategy):
+    seed_everything(args.seed)
+    (x, y), (vx, vy) = mnist_arrays(args)
+    model = Model(MnistCNN(dtype=jnp.bfloat16), strategy)
+    model.compile(
+        optimizer=optax.sgd(args.learning_rate, momentum=args.momentum),
+        loss="sparse_categorical_crossentropy", seed=args.seed)
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              validation_data=(vx, vy),
+              callbacks=[ModelCheckpoint(args.train_dir),
+                         TensorBoard(f"{args.train_dir}/logs")])
+    # EVAL after restore-latest (reference tensorflow2/mnist_single.py:88-92)
+    model.load_latest(args.train_dir)
+    res = model.evaluate(vx, vy, batch_size=args.test_batchsize)
+    print(f"Eval loss: {res['loss']}, Eval Accuracy: {res['accuracy']}",
+          flush=True)
+    if args.save_model:
+        model.save_weights(f"{args.train_dir}/final.msgpack")
+
+
+def main():
+    parser = make_parser("dtdl_tpu: Keras-style MNIST CNN (single)")
+    add_tf2_flags(parser)
+    add_data_flags(parser, dataset="mnist")
+    args = parser.parse_args()
+    bootstrap(args)
+    run(args, SingleDevice())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""MNIST MLP with the Trainer+extensions API (CPU or one device).
+
+Capability parity with reference chainer/train_mnist.py: MLP-1000, Adam,
+Trainer with Evaluator / dump_graph / snapshot / LogReport / PrintReport
+extensions, ``--resume`` from a snapshot (reference :62-125).  Flag names
+match the reference's argparse (:30-47); ``--gpu`` is accepted — device
+choice belongs to JAX here.
+
+    python examples/train_mnist.py -b 100 -e 3 -u 1000 -o result
+    python examples/train_mnist.py --resume result/snapshot_600 -e 5
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from common import bootstrap, mnist_arrays, per_process_loader
+from dtdl_tpu.models import MLP
+from dtdl_tpu.parallel import SingleDevice, choose_strategy
+from dtdl_tpu.train import (Evaluator, LogReport, PrintReport, Trainer,
+                            dump_graph, init_state, make_eval_step,
+                            make_train_step, snapshot)
+from dtdl_tpu.utils import seed_everything
+from dtdl_tpu.utils.config import add_data_flags, flag, make_parser
+
+
+def add_chainer_flags(parser, batchsize=100):
+    """Reference chainer/train_mnist.py:30-47 flag surface."""
+    flag(parser, "--batchsize", "-b", type=int, default=batchsize)
+    flag(parser, "--epoch", "-e", type=int, default=20)
+    flag(parser, "--frequency", "-f", type=int, default=-1,
+         help="snapshot frequency in epochs (-1 = once per epoch)")
+    flag(parser, "--out", "-o", default="result")
+    flag(parser, "--resume", "-r", default="")
+    flag(parser, "--unit", "-u", type=int, default=1000)
+    flag(parser, "--seed", type=int, default=0)
+
+
+def build_trainer(args, strategy, banner_extra=()):
+    key = seed_everything(args.seed)
+    (x, y), (vx, vy) = mnist_arrays(args, flatten=True)
+    train_loader = per_process_loader(x, y, args.batchsize, shuffle=True,
+                                      seed=args.seed)
+    val_loader = per_process_loader(vx, vy, args.batchsize, shuffle=False,
+                                    seed=args.seed, drop_last=False)
+    state = strategy.replicate(init_state(
+        MLP(n_units=args.unit), key, jnp.zeros((1, 784)), optax.adam(1e-3)))
+    trainer = Trainer(state, make_train_step(strategy), train_loader,
+                      strategy, stop_trigger=(args.epoch, "epoch"),
+                      out=args.out)
+    log = LogReport()
+    trainer.extend(Evaluator(make_eval_step(strategy), val_loader, strategy))
+    trainer.extend(dump_graph({"image": x[: args.batchsize],
+                               "label": y[: args.batchsize]}))
+    freq = args.epoch if args.frequency == -1 else max(1, args.frequency)
+    trainer.extend(snapshot(), trigger=(freq, "epoch"))
+    trainer.extend(log)
+    trainer.extend(PrintReport(
+        ["epoch", "iteration", "loss", "accuracy",
+         "val_loss", "val_accuracy", "elapsed_time"], log))
+    return trainer
+
+
+def main():
+    parser = make_parser("dtdl_tpu: Trainer-style MNIST MLP")
+    add_chainer_flags(parser)
+    add_data_flags(parser, dataset="mnist")
+    flag(parser, "--gpu", "-g", type=int, default=-1,
+         help="accepted for parity; JAX owns device selection")
+    args = parser.parse_args()
+    bootstrap(args)
+
+    # rank-0 banner (reference chainer/train_mnist.py:49-58)
+    print("=============================================")
+    print(f"# device: {jax.devices()[0].device_kind}")
+    print(f"# number of units: {args.unit}")
+    print(f"# minibatch-size: {args.batchsize}")
+    print(f"# epoch: {args.epoch}")
+    print("=============================================", flush=True)
+
+    trainer = build_trainer(args, SingleDevice())
+    if args.resume:
+        trainer.resume(args.resume)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""MNIST MLP on all local devices, Trainer API (ParallelUpdater parity).
+
+Capability parity with reference chainer/train_mnist_gpu.py: single-process
+multi-device data parallelism driven by the Trainer.  Chainer's
+``ParallelUpdater`` with a ``{'main': 0, 'second': 1}`` device map (reference
+:87-93) becomes a `DataParallel` strategy over the local mesh — the device
+map is the mesh.
+
+    python examples/train_mnist_gpu.py -b 400 -e 3
+"""
+
+from common import bootstrap
+from dtdl_tpu.parallel import data_parallel_local
+from dtdl_tpu.utils.config import add_data_flags, flag, make_parser
+
+from train_mnist import add_chainer_flags, build_trainer
+
+
+def main():
+    parser = make_parser("dtdl_tpu: Trainer-style MNIST MLP, local DP")
+    add_chainer_flags(parser, batchsize=400)
+    add_data_flags(parser, dataset="mnist")
+    flag(parser, "--gpu0", type=int, default=0,
+         help="accepted for parity (reference device map, "
+              "train_mnist_gpu.py:52-67); the mesh covers all local devices")
+    flag(parser, "--gpu1", type=int, default=1, help="accepted for parity")
+    args = parser.parse_args()
+    bootstrap(args)
+    strategy = data_parallel_local()
+    print(f"ParallelUpdater-style DP over {strategy.num_replicas} local "
+          f"device(s)", flush=True)
+    trainer = build_trainer(args, strategy)
+    if args.resume:
+        trainer.resume(args.resume)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Child script for launcher tests: rendezvous + 2 DDP steps + invariants.
+
+Run via dtdl_tpu.launch.local with --devices-per-proc so each process gets
+its own CPU device set, exactly like one TPU host in a slice.
+"""
+
+import argparse
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dtdl_tpu.models import MLP
+from dtdl_tpu.parallel import distributed_data_parallel
+from dtdl_tpu.runtime import initialize, is_leader
+from dtdl_tpu.train import init_state, make_train_step
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--coordinator", default="")
+parser.add_argument("--num-processes", type=int, default=1)
+parser.add_argument("--process-id", type=int, default=0)
+args = parser.parse_args()
+
+initialize(args.coordinator, args.num_processes, args.process_id)
+assert jax.process_count() == args.num_processes, jax.process_count()
+
+strategy = distributed_data_parallel()
+state = strategy.replicate(init_state(
+    MLP(n_units=16), jax.random.PRNGKey(0), jnp.zeros((1, 784)),
+    optax.sgd(0.1)))
+step = make_train_step(strategy)
+
+# every host feeds ITS stripe; global batch = world_replicas * 4
+rng = np.random.default_rng(args.process_id)
+local = {
+    "image": np.asarray(
+        rng.normal(size=(4 * len(jax.local_devices()), 784)), np.float32),
+    "label": np.asarray(rng.integers(0, 10, 4 * len(jax.local_devices()))),
+}
+for _ in range(2):
+    state, metrics = step(state, strategy.shard_batch(local))
+loss = float(metrics["loss"])
+assert np.isfinite(loss)
+
+# replication invariant across the whole cluster: leader and workers must
+# have identical params (checked via per-host hash printed and compared by
+# the test harness)
+leaf = np.asarray(jax.tree.leaves(jax.device_get(state.params))[0])
+digest = float(np.abs(leaf).sum())
+print(f"RESULT process={jax.process_index()} replicas={strategy.num_replicas} "
+      f"loss={loss:.6f} digest={digest:.6f}", flush=True)

@@ -1,0 +1,110 @@
+"""End-to-end example-script smoke tests (SURVEY §4: 'integration-test each
+example end-to-end for loss decrease on MNIST subsets').
+
+Each reference-parity script runs as a real subprocess on the fake-CPU
+platform with a truncated synthetic dataset.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(REPO, "examples")
+
+CPU_ENV = {
+    **{k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"},
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_example(script, *args, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EX, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=CPU_ENV,
+        cwd=EX)
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_mnist_single_example(tmp_path):
+    out = run_example(
+        "mnist_single.py", "--batch_size", "64", "--epochs", "4",
+        "--learning_rate", "0.1", "--momentum", "0.9",
+        "--limit-train", "512", "--limit-test", "256",
+        "--dataset-dir", str(tmp_path / "none"),
+        "--train_dir", str(tmp_path / "td"))
+    m = re.search(r"Eval loss: ([\d.]+), Eval Accuracy: ([\d.]+)", out)
+    assert m, out
+    assert float(m.group(2)) > 0.5  # learns the synthetic task
+    assert (tmp_path / "td" / "weights_epoch_0003.msgpack").exists()
+
+
+def test_mnist_mirror_strategy_example(tmp_path):
+    out = run_example(
+        "mnist_mirror_strategy.py", "--batch_size", "64", "--epochs", "1",
+        "--limit-train", "512", "--limit-test", "256",
+        "--dataset-dir", str(tmp_path / "none"),
+        "--train_dir", str(tmp_path / "td"))
+    assert "Mirrored DP over 4 local device(s)" in out
+
+
+def test_train_mnist_example_with_resume(tmp_path):
+    out_dir = str(tmp_path / "result")
+    common = ["-b", "100", "-u", "64", "--limit-train", "500",
+              "--limit-test", "200", "--dataset-dir", str(tmp_path / "none"),
+              "-o", out_dir]
+    out = run_example("train_mnist.py", "-e", "2", *common)
+    assert "val_accuracy" in out
+    snaps = [d for d in os.listdir(out_dir) if d.startswith("snapshot_")]
+    assert snaps, os.listdir(out_dir)
+    # resume from the snapshot into a longer run
+    out2 = run_example("train_mnist.py", "-e", "3", "-r",
+                       os.path.join(out_dir, sorted(snaps)[-1]), *common)
+    assert "val_accuracy" in out2
+
+
+def test_train_mnist_gpu_example(tmp_path):
+    out = run_example(
+        "train_mnist_gpu.py", "-b", "100", "-e", "1", "-u", "32",
+        "--limit-train", "400", "--limit-test", "200",
+        "--dataset-dir", str(tmp_path / "none"),
+        "-o", str(tmp_path / "result"))
+    assert "DP over 4 local device(s)" in out
+
+
+@pytest.mark.slow
+def test_train_mnist_multi_example_two_processes(tmp_path):
+    """ChainerMN-parity script through the local launcher, 2 procs."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtdl_tpu.launch.local",
+         "--nproc", "2", "--port", "12455", "--devices-per-proc", "2", "--",
+         os.path.join(EX, "train_mnist_multi.py"),
+         "-b", "80", "-e", "1", "-u", "32",
+         "--limit-train", "400", "--limit-test", "160",
+         "--dataset-dir", str(tmp_path / "none"),
+         "-o", str(tmp_path / "result")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "PYTHONPATH": REPO}, cwd=EX)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Num process (COMM_WORLD): 2" in proc.stdout
+    assert "val_accuracy" in proc.stdout
+
+
+@pytest.mark.slow
+def test_single_device_example_tiny(tmp_path):
+    """PyramidNet path compiles are heavy on CPU; use 300 examples, 1 epoch
+    of a few steps to exercise the script end-to-end."""
+    out = run_example(
+        "single_device.py", "--batch-size", "100", "--epochs", "1",
+        "--limit-train", "300", "--limit-test", "100",
+        "--dataset-dir", str(tmp_path / "none"),
+        "--out", str(tmp_path / "out"), "--dtype", "float32",
+        timeout=900)
+    assert "Epoch [0]" in out
+    assert (tmp_path / "out" / "pyramidnet_final.msgpack").exists()
