@@ -125,4 +125,6 @@ def load_dataset(name: str, root: str = "./datasets", **kwargs):
         return load_cifar10(root, **kwargs)
     if name == "synthetic":
         return synthetic.synthetic_mnist(**kwargs)
+    if name == "synthetic_lm":
+        return synthetic.synthetic_lm(**kwargs)
     raise ValueError(f"unknown dataset {name!r}")

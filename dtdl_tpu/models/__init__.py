@@ -9,12 +9,16 @@ from dtdl_tpu.models.mlp import MLP  # noqa: F401
 from dtdl_tpu.models.cnn import MnistCNN  # noqa: F401
 from dtdl_tpu.models.pyramidnet import PyramidNet, pyramidnet  # noqa: F401
 from dtdl_tpu.models.resnet import ResNet, ResNet50, resnet50  # noqa: F401
+from dtdl_tpu.models.transformer import (  # noqa: F401
+    TransformerLM, transformer_lm,
+)
 
 _REGISTRY = {
     "mlp": lambda **kw: MLP(**kw),
     "mnist_cnn": lambda **kw: MnistCNN(**kw),
     "pyramidnet": lambda **kw: pyramidnet(**kw),
     "resnet50": lambda **kw: resnet50(**kw),
+    "transformer_lm": lambda **kw: transformer_lm(**kw),
 }
 
 
@@ -28,9 +32,15 @@ def get_model(name: str, **kwargs):
 
 def input_spec(name: str) -> tuple[tuple[int, ...], str]:
     """(example input shape without batch dim, dataset name) per model."""
-    return {
+    specs = {
         "mlp": ((784,), "mnist"),
         "mnist_cnn": ((28, 28, 1), "mnist"),
         "pyramidnet": ((32, 32, 3), "cifar10"),
         "resnet50": ((224, 224, 3), "imagenet"),
-    }[name]
+        "transformer_lm": ((128,), "synthetic_lm"),
+    }
+    try:
+        return specs[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; have {sorted(specs)}") from None

@@ -1,0 +1,241 @@
+"""Decoder-only Transformer language model (the long-context flagship).
+
+The reference tops out at CNNs/MLPs over 784-pixel images (SURVEY §5.7 —
+reference pytorch/model.py:53-118, chainer/train_mnist_multi.py:15-28); this
+framework treats sequence models and long context as first-class, so the
+model zoo gains a modern decoder-only LM:
+
+* pre-norm blocks, RMSNorm, rotary position embeddings, SwiGLU MLP
+* causal **flash attention** via the Pallas TPU kernel
+  (dtdl_tpu/ops/attention.py); ``attn_impl='dense'`` selects the reference
+  einsum path for numerics tests
+* optional **mixture-of-experts** MLP (top-1 switch routing, XLA-friendly
+  dense dispatch via one-hot einsum — no dynamic shapes)
+* every parameter is annotated with flax *logical axes* so the same module
+  runs replicated, FSDP, or tensor-parallel under pjit by flipping the
+  logical→mesh rules (dtdl_tpu/parallel/tensor.py)
+* ``remat`` applies ``jax.checkpoint`` per block — the standard TPU
+  memory/FLOPs trade for long sequences
+
+Logical axis names: 'vocab', 'embed', 'heads', 'head_dim' (attention
+projections), 'mlp' (FFN hidden), 'expert' (MoE).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dtdl_tpu.ops.attention import flash_attention, mha_reference
+from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
+
+Dtype = Any
+
+
+def _part(init, *names):
+    return nn.with_logical_partitioning(init, names)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", _part(nn.initializers.ones, "embed"),
+                           (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    n_heads: int
+    head_dim: int
+    attn_impl: str = "flash"      # 'flash' | 'dense'
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        d_model = x.shape[-1]
+        def proj(name):
+            return nn.DenseGeneral(
+                features=(self.n_heads, self.head_dim), axis=-1,
+                use_bias=False, dtype=self.dtype,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  "embed", "heads", "head_dim"),
+                name=name)
+        q = proj("q")(x)
+        k = proj("k")(x)
+        v = proj("v")(x)
+        # [B, S, H, D] -> [B, H, S, D]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if self.attn_impl == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = mha_reference(q, k, v, causal=True).astype(self.dtype)
+        o = o.transpose(0, 2, 1, 3)
+        return nn.DenseGeneral(
+            features=d_model, axis=(-2, -1), use_bias=False, dtype=self.dtype,
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              "heads", "head_dim", "embed"),
+            name="out")(o)
+
+
+class SwiGLU(nn.Module):
+    d_ff: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        wi = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                      kernel_init=_part(nn.initializers.lecun_normal(),
+                                        "embed", "mlp"), name="wi")(x)
+        wg = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                      kernel_init=_part(nn.initializers.lecun_normal(),
+                                        "embed", "mlp"), name="wg")(x)
+        h = nn.silu(wg) * wi
+        return nn.Dense(d_model, use_bias=False, dtype=self.dtype,
+                        kernel_init=_part(nn.initializers.lecun_normal(),
+                                          "mlp", "embed"), name="wo")(h)
+
+
+class MoE(nn.Module):
+    """Top-1 switch MLP with dense one-hot dispatch (static shapes).
+
+    Router picks one expert per token; dispatch/combine are einsums against a
+    one-hot mask, so XLA sees fixed-shape batched matmuls it can put on the
+    MXU and partition over an 'expert' mesh axis.  A load-balancing auxiliary
+    loss is stashed via ``self.sow`` under 'aux_loss'.
+    """
+    n_experts: int
+    d_ff: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d_model = x.shape
+        router = nn.Dense(self.n_experts, use_bias=False, dtype=jnp.float32,
+                          kernel_init=_part(nn.initializers.lecun_normal(),
+                                            "embed", "expert"),
+                          name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(router, axis=-1)          # [b, s, E]
+        idx = jnp.argmax(probs, axis=-1)
+        onehot = jax.nn.one_hot(idx, self.n_experts, dtype=jnp.float32)
+        gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)
+
+        # load-balance aux loss (Switch Transformer): E * <f, p>
+        frac_tokens = onehot.mean(axis=(0, 1))
+        frac_probs = probs.mean(axis=(0, 1))
+        self.sow("aux_loss", "moe",
+                 self.n_experts * jnp.sum(frac_tokens * frac_probs))
+
+        def expert_param(name, shape, in_ax, out_ax):
+            # batch_axis keeps the expert dim out of fan_in so every expert
+            # initializes like its dense counterpart
+            init = nn.initializers.lecun_normal(batch_axis=(0,))
+            return self.param(
+                name, _part(init, *(("expert",) + (in_ax, out_ax))), shape)
+
+        w_in = expert_param("wi", (self.n_experts, d_model, self.d_ff),
+                            "embed", "mlp").astype(self.dtype)
+        w_gate = expert_param("wg", (self.n_experts, d_model, self.d_ff),
+                              "embed", "mlp").astype(self.dtype)
+        w_out = expert_param("wo", (self.n_experts, self.d_ff, d_model),
+                             "mlp", "embed").astype(self.dtype)
+
+        # dense dispatch: xe[e, b, s, d] = onehot[b, s, e] * x[b, s, d]
+        xe = jnp.einsum("bse,bsd->ebsd", onehot.astype(self.dtype), x)
+        h = nn.silu(jnp.einsum("ebsd,edf->ebsf", xe, w_gate)) * \
+            jnp.einsum("ebsd,edf->ebsf", xe, w_in)
+        y = jnp.einsum("ebsf,efd->bsd", h, w_out)
+        return y * gate.astype(self.dtype)
+
+
+class Block(nn.Module):
+    n_heads: int
+    head_dim: int
+    d_ff: int
+    n_experts: int = 0
+    attn_impl: str = "flash"
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        h = RMSNorm(dtype=self.dtype, name="ln_attn")(x)
+        x = x + Attention(self.n_heads, self.head_dim, self.attn_impl,
+                          self.dtype, name="attn")(h, cos, sin)
+        h = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
+        if self.n_experts > 0:
+            x = x + MoE(self.n_experts, self.d_ff, self.dtype,
+                        name="moe")(h)
+        else:
+            x = x + SwiGLU(self.d_ff, self.dtype, name="mlp")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM; input int32 tokens [batch, seq] -> logits f32."""
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 1408
+    max_seq: int = 2048
+    n_experts: int = 0            # 0 = dense SwiGLU MLP
+    moe_every: int = 2            # every k-th block is MoE (when n_experts>0)
+    attn_impl: str = "flash"
+    remat: bool = False
+    dtype: Dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        del train
+        emb = self.param(
+            "embed", _part(nn.initializers.normal(stddev=0.02),
+                           "vocab", "embed"),
+            (self.vocab_size, self.d_model))
+        x = jnp.take(emb, tokens, axis=0).astype(self.dtype)
+        cos, sin = rope_frequencies(self.head_dim, self.max_seq)
+
+        block_cls = Block
+        if self.remat:
+            block_cls = nn.remat(Block, static_argnums=())
+        for i in range(self.n_layers):
+            moe = (self.n_experts > 0 and
+                   (i + 1) % self.moe_every == 0)
+            x = block_cls(
+                self.n_heads, self.head_dim, self.d_ff,
+                n_experts=self.n_experts if moe else 0,
+                attn_impl=self.attn_impl, dtype=self.dtype,
+                name=f"block_{i}")(x, cos, sin)
+
+        x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(self.dtype))
+        return logits.astype(jnp.float32)
+
+
+def transformer_lm(size: str = "tiny", **overrides) -> TransformerLM:
+    """Named configs; 'tiny' fits the CPU test mesh, 'base' the bench chip."""
+    cfgs = {
+        "tiny": dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                     d_ff=128, max_seq=128),
+        "small": dict(vocab_size=8192, d_model=256, n_layers=4, n_heads=8,
+                      d_ff=704, max_seq=1024),
+        "base": dict(vocab_size=32000, d_model=512, n_layers=8, n_heads=8,
+                     d_ff=1408, max_seq=2048),
+    }
+    cfg = dict(cfgs[size])
+    cfg.update(overrides)
+    return TransformerLM(**cfg)

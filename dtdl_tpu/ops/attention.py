@@ -1,0 +1,385 @@
+"""Fused multi-head attention — Pallas TPU flash-attention kernels.
+
+The reference has no attention at all (SURVEY §5.7: CNN/MLP only, reference
+pytorch/model.py:53-118, chainer/train_mnist_multi.py:15-28); long-context
+sequence models are a first-class capability of *this* framework, so the hot
+op gets a real TPU kernel rather than a dense softmax(QK^T)V.
+
+Design (the standard TPU flash decomposition):
+
+* forward — grid ``(batch*heads, q_blocks, k_blocks)``; the k dimension is the
+  innermost (sequential) grid axis, so VMEM scratch carries the online-softmax
+  state (running max ``m``, normalizer ``l``, accumulator ``acc``) across k
+  steps.  O(S) memory instead of O(S²); the S×S score matrix never exists.
+* backward — two kernels with the same tiling: one accumulates ``dq`` over k
+  blocks, one accumulates ``dk``/``dv`` over q blocks, both recomputing the
+  probability tile from the saved logsumexp (no S×S residual is stored).
+* causal masking skips whole tiles above the diagonal via ``pl.when`` so the
+  MXU only sees tiles that contribute.
+
+On non-TPU backends (the 8-virtual-device CPU test mesh, SURVEY §4) the same
+kernels run under the Pallas interpreter, so every test exercises the exact
+kernel code path the TPU compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _zero_pad_rows(x, block_start, valid_total):
+    """Zero rows past the logical array end in a ragged tail tile.
+
+    Pallas pads out-of-bounds tile regions (NaN under the interpreter,
+    unspecified on hardware); masked-to-zero probabilities times padded
+    NaN/garbage still poison matmul accumulations, so padded rows are
+    explicitly zeroed before any dot.
+    """
+    rows = block_start + lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(rows < valid_total, x, 0.0)
+
+
+def mha_reference(q, k, v, *, causal: bool = True, scale: float | None = None):
+    """Dense reference attention (numerics oracle for the kernels).
+
+    q,k,v: [batch, heads, seq, head_dim]  (k/v seq may differ from q's).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                seq_k, off):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # tiles strictly above the (bottom-aligned) diagonal contribute nothing
+    guard = (ki * block_k < (qi + 1) * block_q + off) if causal else (ki >= 0)
+
+    @pl.when(guard)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+
+        cols = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            # bottom-aligned diagonal (== mha_reference's tril(k=sk-sq)):
+            # query row i attends keys <= i + (seq_k - seq_q)
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(rows + off >= cols, s, NEG_INF)
+        if seq_k % block_k:                        # mask padded tail keys
+            s = jnp.where(cols < seq_k, s, NEG_INF)
+
+        m_prev = m_scr[:]                          # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)           # [bk, d]
+        if seq_k % block_k:
+            v = _zero_pad_rows(v, ki * block_k, seq_k)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # lse layout [bh, 1, sq]: keeps the trailing block dims TPU-tileable
+        lse_ref[0] = (m_scr[:] + jnp.log(l_safe)).reshape(1, -1)
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=sk, off=sk - sq)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=_scratch(block_q, d),
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+def _scratch(block_q, d):
+    from jax.experimental.pallas import tpu as pltpu
+    return [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, d), jnp.float32),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k, seq_k, off):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    guard = (ki * block_k < (qi + 1) * block_q + off) if causal else (ki >= 0)
+
+    @pl.when(guard)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].reshape(block_q, 1)      # [bq, 1]
+        delta = delta_ref[0].reshape(block_q, 1)  # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        cols = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(rows + off >= cols, s, NEG_INF)
+        if seq_k % block_k:
+            s = jnp.where(cols < seq_k, s, NEG_INF)
+            k = _zero_pad_rows(k, ki * block_k, seq_k)
+            v = _zero_pad_rows(v, ki * block_k, seq_k)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k, seq_k, seq_q, off):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    guard = ((qi + 1) * block_q + off > ki * block_k) if causal else (qi >= 0)
+
+    @pl.when(guard)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].reshape(block_q, 1)
+        delta = delta_ref[0].reshape(block_q, 1)
+        if seq_q % block_q:
+            q = _zero_pad_rows(q, qi * block_q, seq_q)
+            do = _zero_pad_rows(do, qi * block_q, seq_q)
+            lse = _zero_pad_rows(lse, qi * block_q, seq_q)
+            delta = _zero_pad_rows(delta, qi * block_q, seq_q)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        cols = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(rows + off >= cols, s, NEG_INF)
+        if seq_k % block_k:
+            s = jnp.where(cols < seq_k, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale              # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bk, d]
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do_4d):
+    q, k, v, o, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    do = do_4d
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]          # [bh, 1, sq]
+
+    grid_dq = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk,
+                          off=sk - sq),
+        grid=grid_dq,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[_scratch(block_q, d)[2]],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    grid_dkv = (bh, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk,
+                          seq_q=sq, off=sk - sq),
+        grid=grid_dkv,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            _scratch(block_k, d)[2], _scratch(block_k, d)[2],
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, do):
+    return _bwd(scale, causal, block_q, block_k, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Flash attention over [batch, heads, seq, head_dim] tensors.
+
+    Differentiable (custom VJP, recompute-based backward); O(seq) memory.
+    Falls back to the Pallas interpreter off-TPU so CPU tests run the same
+    kernel code.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if not _use_interpret():
+        # Mosaic tiling: a block's trailing dims must be (8,128)-multiples or
+        # span the whole array dim; normalize block sizes so any seq length
+        # lowers (whole-seq block below 128, 128-multiples above).
+        block_q = sq if sq <= block_q else max(128, block_q // 128 * 128)
+        block_k = sk if sk <= block_k else max(128, block_k // 128 * 128)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    o = _flash(qf, kf, vf, scale, causal, block_q, block_k)
+    return o.reshape(b, h, sq, d)

@@ -1,0 +1,43 @@
+"""Rotary position embeddings (RoPE).
+
+Shared by the flax TransformerLM (dtdl_tpu/models/transformer.py) and the
+manual-SPMD megatron step (dtdl_tpu/parallel/megatron.py).  Position-offset
+aware so sequence-parallel shards can rotate their *global* positions
+(device i of a ``seq`` axis passes ``offset = i * seq_local``).
+
+The reference has no sequence models (SURVEY §5.7); this op exists for the
+framework's first-class long-context capability.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """Precompute (cos, sin) tables of shape [max_seq, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(max_seq, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x, cos, sin, offset=0):
+    """Rotate [batch, heads, seq, head_dim] queries/keys.
+
+    ``offset`` (int or traced scalar) is the global position of the shard's
+    first token — the hook sequence parallelism uses.
+    """
+    seq = x.shape[2]
+    if isinstance(offset, int) and offset == 0:
+        c, s = cos[:seq], sin[:seq]
+    else:
+        c = jnp.take(cos, offset + jnp.arange(seq), axis=0)
+        s = jnp.take(sin, offset + jnp.arange(seq), axis=0)
+    c = c[None, None, :, :]
+    s = s[None, None, :, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

@@ -3,3 +3,10 @@ from dtdl_tpu.parallel.strategy import (  # noqa: F401
     data_parallel_local, distributed_data_parallel, choose_strategy,
 )
 from dtdl_tpu.parallel import collectives  # noqa: F401
+from dtdl_tpu.parallel.sequence import (  # noqa: F401
+    ring_attention, ulysses_attention,
+)
+from dtdl_tpu.parallel.megatron import (  # noqa: F401
+    MegatronConfig, build_4d_mesh, factor_mesh,
+    make_megatron_train_step,
+)

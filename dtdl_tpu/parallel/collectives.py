@@ -77,6 +77,22 @@ def axis_index(axis: str = DATA_AXIS):
     return lax.axis_index(axis)
 
 
+def pvary_like(x, *refs):
+    """Cast ``x`` to vary over every manual axis any of ``refs`` varies over.
+
+    shard_map's VMA typing requires scan carries to enter with the same
+    varying-axis set they leave with; zero-initialized accumulators start
+    unvarying, so loops that mix them with sharded activations must pre-cast.
+    No-op outside shard_map.
+    """
+    want = set()
+    for r in refs:
+        want |= set(getattr(jax.typeof(r), "vma", ()) or ())
+    have = set(getattr(jax.typeof(x), "vma", ()) or ())
+    missing = tuple(sorted(want - have))
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
 # ---- host-level (outside-jit) utilities ------------------------------------
 
 def host_broadcast(tree, is_source: bool | None = None):

@@ -1,0 +1,405 @@
+"""Manual-SPMD 4D-parallel transformer train step (dp × sp × pp × tp + ep).
+
+The reference's only parallelism is batch data-parallel over NCCL (SURVEY
+§2.2); this module is the framework's scale path beyond it: one
+``shard_map`` over a 4-axis mesh ``('data', 'seq', 'pipe', 'model')``
+composing every distributed-training dimension, with all collectives
+explicit so they can be audited and scheduled:
+
+* **dp**  — batch sharded over 'data'; gradient reduction falls out of the
+  VMA-typed autodiff (the loss psum over 'data' transposes to the allreduce
+  DDP fires from its grad hooks, reference
+  pytorch/distributed_data_parallel.py:74,132).
+* **sp**  — sequence sharded over 'seq'; **ring attention** rotates K/V via
+  ``lax.ppermute`` (dtdl_tpu/parallel/sequence.py) — one ICI hop per step.
+* **pp**  — layers stacked ``[n_stages, layers_per_stage, ...]`` and sharded
+  over 'pipe'; a GPipe microbatch schedule runs as a ``lax.scan`` over
+  ticks with a ``ppermute`` stage-to-stage handoff.  Autodiff through the
+  scan+ppermute yields the reverse-schedule backward automatically.
+* **tp**  — Megatron column→row parallel attention/MLP over 'model':
+  QKV/up projections column-sharded, out/down projections row-sharded, one
+  ``psum`` after attention-out and one after MLP-down per block.
+* **ep**  — MoE experts sharded over 'model' (expert-parallel on the tensor
+  axis): tokens are masked to their expert via one-hot dense dispatch, each
+  device computes its local experts, and the same row-parallel ``psum``
+  combines expert outputs — no extra collective beyond TP's.
+
+Parameters are a plain pytree whose leaves carry global shapes; shard_map's
+``in_specs`` (from ``param_specs``) place them.  Everything here is pure
+JAX — the flax TransformerLM (dtdl_tpu/models/transformer.py) is the
+single-device/GSPMD face of the same architecture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
+from dtdl_tpu.parallel.sequence import ring_attention
+
+DATA, SEQ, PIPE, MODEL = "data", "seq", "pipe", "model"
+AXES = (DATA, SEQ, PIPE, MODEL)
+
+
+@dataclasses.dataclass(frozen=True)
+class MegatronConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    d_ff: int = 128
+    n_stages: int = 2             # pipeline stages  (== mesh 'pipe' size)
+    layers_per_stage: int = 1
+    n_experts: int = 0            # 0 = dense MLP; else experts over 'model'
+    max_seq: int = 128
+    n_microbatches: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def n_layers(self):
+        return self.n_stages * self.layers_per_stage
+
+
+def factor_mesh(n_devices: int) -> tuple[int, int, int, int]:
+    """(data, seq, pipe, model) sizes — every axis >1 as soon as n allows."""
+    model = 2 if n_devices % 2 == 0 else 1
+    pipe = 2 if n_devices % 4 == 0 else 1
+    seq = 2 if n_devices % 8 == 0 else 1
+    data = n_devices // (model * pipe * seq)
+    return (data, seq, pipe, model)
+
+
+def build_4d_mesh(devices=None) -> Mesh:
+    from dtdl_tpu.runtime.mesh import build_mesh
+    if devices is None:
+        devices = jax.devices()
+    return build_mesh(shape=factor_mesh(len(devices)), axes=AXES,
+                      devices=devices)
+
+
+# ---------------------------------------------------------------------------
+# parameters
+# ---------------------------------------------------------------------------
+
+def param_specs(cfg: MegatronConfig) -> dict:
+    """PartitionSpec per parameter (global-shape view).
+
+    Stacked block params lead with a [n_stages, layers_per_stage, ...]
+    prefix sharded on 'pipe'; TP shards the head/ff dims on 'model'; expert
+    weights shard the expert dim on 'model' (ep-on-tp).
+    """
+    specs = {
+        "embed": P(None, None),            # [V, D] replicated
+        "ln_f": P(),                       # [D]
+        "blocks": {
+            "ln_attn": P(PIPE),            # [st, L, D]
+            "wq": P(PIPE, None, None, MODEL),   # [st, L, D, H*hd] col-parallel
+            "wk": P(PIPE, None, None, MODEL),
+            "wv": P(PIPE, None, None, MODEL),
+            "wo": P(PIPE, None, MODEL, None),   # [st, L, H*hd, D] row-parallel
+            "ln_mlp": P(PIPE),
+        },
+    }
+    if cfg.n_experts:
+        specs["blocks"].update({
+            "router": P(PIPE, None, None, None),     # [st, L, D, E]
+            "wi": P(PIPE, None, MODEL, None, None),  # [st, L, E, D, F]
+            "wg": P(PIPE, None, MODEL, None, None),
+            "wo_mlp": P(PIPE, None, MODEL, None, None),  # [st, L, E, F, D]
+        })
+    else:
+        specs["blocks"].update({
+            "wi": P(PIPE, None, None, MODEL),   # [st, L, D, F] col-parallel
+            "wg": P(PIPE, None, None, MODEL),
+            "wo_mlp": P(PIPE, None, MODEL, None),  # [st, L, F, D] row-parallel
+        })
+    return specs
+
+
+def init_params(cfg: MegatronConfig, key) -> dict:
+    """Global-shape parameter pytree (host-side init, then device_put)."""
+    st, L, D = cfg.n_stages, cfg.layers_per_stage, cfg.d_model
+    H, F, E = cfg.n_heads * cfg.head_dim, cfg.d_ff, cfg.n_experts
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape):
+        fan_in = shape[-2]
+        return (jax.random.normal(k, shape, jnp.float32) /
+                math.sqrt(fan_in)).astype(jnp.float32)
+
+    blocks = {
+        "ln_attn": jnp.ones((st, L, D)),
+        "wq": dense(next(keys), (st, L, D, H)),
+        "wk": dense(next(keys), (st, L, D, H)),
+        "wv": dense(next(keys), (st, L, D, H)),
+        "wo": dense(next(keys), (st, L, H, D)),
+        "ln_mlp": jnp.ones((st, L, D)),
+    }
+    if E:
+        blocks.update({
+            "router": dense(next(keys), (st, L, D, E)),
+            "wi": dense(next(keys), (st, L, E, D, F)),
+            "wg": dense(next(keys), (st, L, E, D, F)),
+            "wo_mlp": dense(next(keys), (st, L, E, F, D)),
+        })
+    else:
+        blocks.update({
+            "wi": dense(next(keys), (st, L, D, F)),
+            "wg": dense(next(keys), (st, L, D, F)),
+            "wo_mlp": dense(next(keys), (st, L, F, D)),
+        })
+    return {
+        "embed": jax.random.normal(next(keys), (cfg.vocab_size, D)) * 0.02,
+        "ln_f": jnp.ones((D,)),
+        "blocks": blocks,
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-stage forward (runs on local shards inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _rms(x, scale, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    return (x32 * lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+            * scale).astype(x.dtype)
+
+
+def _attention(cfg, p, x, cos, sin):
+    """TP column→row attention with ring attention over 'seq'.
+
+    ``p`` holds one layer's weights (wq/wk/wv [D, H/tp·hd], wo [H/tp·hd, D]).
+    """
+    b, s_loc, _ = x.shape
+    h_loc = p["wq"].shape[-1] // cfg.head_dim    # local heads (H / tp)
+
+    def proj(w):
+        y = jnp.einsum("bsd,dh->bsh", x, w.astype(cfg.dtype))
+        return y.reshape(b, s_loc, h_loc, cfg.head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = proj(p["wq"]), proj(p["wk"]), proj(p["wv"])
+    offset = lax.axis_index(SEQ) * s_loc
+    q = apply_rope(q, cos, sin, offset=offset)
+    k = apply_rope(k, cos, sin, offset=offset)
+    o = ring_attention(q, k, v, axis_name=SEQ, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s_loc, h_loc * cfg.head_dim)
+    y = jnp.einsum("bsh,hd->bsd", o, p["wo"].astype(cfg.dtype))
+    return lax.psum(y, MODEL)                    # row-parallel combine
+
+
+def _mlp_dense(cfg, p, x):
+    wi = p["wi"].astype(cfg.dtype)
+    wg = p["wg"].astype(cfg.dtype)
+    wo = p["wo_mlp"].astype(cfg.dtype)
+    h = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, wg)) * \
+        jnp.einsum("bsd,df->bsf", x, wi)
+    return lax.psum(jnp.einsum("bsf,fd->bsd", h, wo), MODEL)
+
+
+def _mlp_moe(cfg, p, x):
+    """Expert-parallel switch MLP: local experts, one-hot dispatch, psum."""
+    e_loc = p["wi"].shape[0]                     # [E/tp, D, F] local experts
+    my = lax.axis_index(MODEL)
+    router = p["router"]                         # [D, E] replicated
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router)
+    probs = jax.nn.softmax(logits, -1)
+    idx = jnp.argmax(probs, -1)                  # [b, s] global expert id
+    gate = jnp.max(probs, -1, keepdims=True)     # top-1 gate value
+    local_id = idx - my * e_loc                  # position among my experts
+    onehot = jax.nn.one_hot(local_id, e_loc, dtype=jnp.float32)  # 0 off-shard
+
+    wi = p["wi"].astype(cfg.dtype)               # [e_loc, D, F]
+    wg = p["wg"].astype(cfg.dtype)
+    wo = p["wo_mlp"].astype(cfg.dtype)
+    xe = jnp.einsum("bse,bsd->ebsd", onehot.astype(cfg.dtype), x)
+    h = jax.nn.silu(jnp.einsum("ebsd,edf->ebsf", xe, wg)) * \
+        jnp.einsum("ebsd,edf->ebsf", xe, wi)
+    y = jnp.einsum("ebsf,efd->bsd", h, wo)
+    return lax.psum(y, MODEL) * gate.astype(cfg.dtype)
+
+
+def _stage_forward(cfg, stage_params, x, cos, sin):
+    """Apply this stage's blocks: lax.scan over the stacked layer dim."""
+    def block(x, p):
+        h = _rms(x, p["ln_attn"])
+        x = x + _attention(cfg, p, h, cos, sin)
+        h = _rms(x, p["ln_mlp"])
+        if cfg.n_experts:
+            x = x + _mlp_moe(cfg, p, h)
+        else:
+            x = x + _mlp_dense(cfg, p, h)
+        return x, None
+
+    x, _ = lax.scan(block, x, stage_params)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# the GPipe schedule + loss (inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _pipeline(cfg, params, x_micro, cos, sin):
+    """Run microbatches through the pipe; returns stacked outputs.
+
+    ``x_micro``: [n_micro, mb, s_loc, D] local embedded microbatches.
+    Stage s processes tick t's buffer if ``0 <= t - s < n_micro``; a
+    ``ppermute`` shifts buffers to the next stage each tick.  Output
+    microbatch m leaves the last stage at tick ``m + n_stages - 1``.
+    """
+    stage = lax.axis_index(PIPE)
+    n_stages, n_micro = cfg.n_stages, cfg.n_microbatches
+    stage_params = jax.tree.map(lambda a: a[0], params["blocks"])
+    # NB: shard_map has already sliced the [n_stages, ...] dim to size 1.
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    mb_shape = x_micro.shape[1:]
+    n_ticks = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 injects microbatch t (garbage after n_micro ticks, masked)
+        inject = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        buf = jnp.where(stage == 0, inject, buf)
+        y = _stage_forward(cfg, stage_params, buf, cos, sin)
+        # last stage collects output microbatch t - (n_stages - 1)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        collect = (stage == n_stages - 1) & (t >= n_stages - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(collect,
+                               y.astype(outputs.dtype),
+                               lax.dynamic_index_in_dim(
+                                   outputs, out_idx, 0, keepdims=False)),
+            out_idx, 0)
+        buf = lax.ppermute(y, PIPE, perm)
+        return (buf, outputs), None
+
+    # Carry vma: activations vary over the batch axes and (once stage params
+    # touch them) 'pipe'; they stay *invariant* over 'model' because every
+    # block ends in a psum(MODEL).  Pre-cast the injected microbatches and the
+    # zero-init carries to exactly that set so the scan types close.
+    vary_axes = tuple(sorted(
+        set(jax.typeof(x_micro).vma or ()) | {PIPE}))
+    x_micro = lax.pcast(
+        x_micro, tuple(a for a in vary_axes
+                       if a not in (jax.typeof(x_micro).vma or ())),
+        to="varying")
+    buf0 = lax.pcast(jnp.zeros(mb_shape, cfg.dtype), vary_axes, to="varying")
+    outs0 = lax.pcast(jnp.zeros((n_micro,) + mb_shape, cfg.dtype),
+                      vary_axes, to="varying")
+    (_, outputs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(n_ticks))
+    # broadcast last stage's outputs to every stage (head/loss replicated)
+    outputs = lax.psum(
+        jnp.where(stage == n_stages - 1, outputs,
+                  jnp.zeros_like(outputs)), PIPE)
+    return outputs
+
+
+def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
+    """Global-mean causal LM loss on local shards. Inside shard_map.
+
+    tokens/targets/mask: [b_loc, s_loc] int32 / int32 / f32.
+    """
+    b_loc, s_loc = tokens.shape
+    n_micro = cfg.n_microbatches
+    emb = params["embed"]
+    x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)   # [b, s, D]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq)
+
+    mb = b_loc // n_micro
+    x_micro = x.reshape(n_micro, mb, s_loc, cfg.d_model)
+    y = _pipeline(cfg, params, x_micro, cos, sin)
+    y = y.reshape(b_loc, s_loc, cfg.d_model)
+
+    y = _rms(y, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", y.astype(jnp.float32),
+                        emb.astype(jnp.float32))
+    lse = jax.nn.logsumexp(logits, -1)
+    true_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    local_sum = jnp.sum((lse - true_logit) * mask)
+    total = lax.psum(jnp.sum(mask), (DATA, SEQ))
+    loss = lax.psum(local_sum, (DATA, SEQ)) / jnp.maximum(total, 1.0)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def opt_state_specs(cfg: MegatronConfig, optimizer):
+    """PartitionSpecs for the optimizer state: param-like leaves (momentum,
+    second moments) shard exactly like their parameters; scalar bookkeeping
+    (step counts) is replicated."""
+    import optax
+    specs = param_specs(cfg)
+    shapes = jax.eval_shape(partial(init_params, cfg), jax.random.PRNGKey(0))
+    state_shape = jax.eval_shape(optimizer.init, shapes)
+    return optax.tree_map_params(
+        optimizer, lambda _, s: s, state_shape, specs,
+        transform_non_params=lambda _: P())
+
+
+def make_megatron_train_step(cfg: MegatronConfig, mesh: Mesh, optimizer):
+    """Compiled 4D-parallel train step ``(params, opt_state, batch) -> ...``.
+
+    ``batch``: dict of global arrays — 'tokens'/'targets' int32
+    [global_batch, global_seq], 'mask' float32 — sharded
+    P('data', 'seq') by :func:`shard_lm_batch`.  Gradient reductions over
+    every axis fall out of VMA-typed autodiff: params enter unvarying, the
+    loss psums make them exact (no hand-written grad allreduce to get wrong).
+    """
+    if cfg.n_stages != mesh.shape[PIPE]:
+        raise ValueError(
+            f"cfg.n_stages={cfg.n_stages} must equal mesh 'pipe' size "
+            f"{mesh.shape[PIPE]}")
+    specs = param_specs(cfg)
+    o_specs = opt_state_specs(cfg, optimizer)
+
+    def step(params, opt_state, tokens, targets, mask):
+        loss, grads = jax.value_and_grad(
+            partial(_loss_fn, cfg))(params, tokens, targets, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    batch_spec = P(DATA, SEQ)
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, o_specs, batch_spec, batch_spec, batch_spec),
+        out_specs=(specs, o_specs, P()),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1))
+
+
+def init_optimizer(cfg: MegatronConfig, mesh: Mesh, optimizer, params):
+    """Optimizer state placed with param-aligned shardings."""
+    state = optimizer.init(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state, opt_state_specs(cfg, optimizer))
+
+
+def shard_lm_batch(mesh: Mesh, batch: dict) -> dict:
+    """Place tokens/targets/mask as [batch@'data', seq@'seq'] global arrays."""
+    sharding = NamedSharding(mesh, P(DATA, SEQ))
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    return {k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in batch.items()}
+
+
+def place_params(mesh: Mesh, cfg: MegatronConfig, params: dict) -> dict:
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)

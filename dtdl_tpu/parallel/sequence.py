@@ -1,0 +1,120 @@
+"""Sequence/context parallelism — ring attention and Ulysses all-to-all.
+
+The reference scales only the batch dimension (SURVEY §5.7: CNN/MLP models,
+no sequence dimension at all); long-context training is a first-class
+capability here, so the framework ships both canonical TPU sequence-parallel
+schemes.  Both are written to be called *inside* ``shard_map`` with
+activations sharded on a ``seq`` mesh axis:
+
+* **ring attention** (blockwise, RingAttention-style): K/V shards rotate
+  around the mesh axis via ``lax.ppermute`` (one ICI hop per step — exactly
+  the neighbor-exchange the TPU torus is built for) while each device
+  accumulates its queries' attention over every K/V block with the online
+  softmax (running max ``m``, normalizer ``l``).  O(S_local²·ring) compute,
+  O(S_local) memory per device; the full S×S score matrix never exists on
+  any one chip.  Differentiable by construction (scan + ppermute transpose).
+
+* **Ulysses** (all-to-all head/sequence transpose): one ``lax.all_to_all``
+  re-shards activations from sequence-sharded to head-sharded, local flash
+  attention (the Pallas kernel from dtdl_tpu.ops.attention) runs over the
+  full sequence on a head subset, and a second all-to-all restores sequence
+  sharding.  Cheaper than a ring when heads ≥ axis size and the all-to-all
+  fits ICI.
+
+Gradient flow needs no hand-written backward: XLA transposes ``ppermute`` /
+``all_to_all`` to their inverses, which *is* the ring/all-to-all backward
+pass of the papers.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+SEQ_AXIS = "seq"
+NEG_INF = -1e30
+
+
+def _axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
+                   causal: bool = True, scale: float | None = None):
+    """Blockwise ring attention over a sequence-sharded mesh axis.
+
+    Call inside ``shard_map``; q/k/v are the local shards
+    ``[batch, heads, seq_local, head_dim]`` of a global sequence laid out
+    contiguously along the axis (device i holds positions
+    ``[i*seq_local, (i+1)*seq_local)``).  Returns the local output shard.
+    """
+    n = _axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    q32 = q.astype(jnp.float32)
+    pos_q = my * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, o, m, l = carry
+        src = (my - t) % n                        # original owner of k_blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            pos_k = src * s_loc + lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1)
+            s = jnp.where(pos_q >= pos_k, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (k_blk, v_blk, o_new, m_new, l_new), None
+
+    from dtdl_tpu.parallel.collectives import pvary_like
+    o0 = pvary_like(jnp.zeros((b, h, s_loc, d), jnp.float32), q, k, v)
+    m0 = pvary_like(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32), q, k, v)
+    l0 = pvary_like(jnp.zeros((b, h, s_loc, 1), jnp.float32), q, k, v)
+    (k, v, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)               # fully-masked rows (non-causal corner)
+    return (o / l).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
+                      causal: bool = True, scale: float | None = None,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses-style SP: all-to-all seq→heads, attend, reverse.
+
+    Requires ``heads %% axis_size == 0``.  ``attn_fn(q, k, v, causal, scale)``
+    defaults to the Pallas flash kernel over the full gathered sequence.
+    """
+    from dtdl_tpu.ops.attention import flash_attention
+    n = _axis_size(axis_name)
+    if q.shape[1] % n:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by axis size {n}")
+    if attn_fn is None:
+        def attn_fn(q, k, v, causal, scale):
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    def to_heads(x):   # [B, H, S/n, D] -> [B, H/n, S, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):     # [B, H/n, S, D] -> [B, H, S/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    o = attn_fn(to_heads(q), to_heads(k), to_heads(v), causal, scale)
+    return to_seq(o)
