@@ -1,0 +1,134 @@
+"""Native C++ runtime: build, batch pipeline, IDX IO, topology probe.
+
+Skipped wholesale if the toolchain can't build the library (the framework's
+pure-Python fallbacks are covered by the other suites).
+"""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from dtdl_tpu import native
+from dtdl_tpu.data.loader import DataLoader
+from dtdl_tpu.data.native_loader import NativeDataLoader, read_idx_native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _data(n=64, h=8, w=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n, h, w, c)).astype(np.float32),
+            rng.integers(0, 10, n).astype(np.int32))
+
+
+def test_order_matches_python_loader_unshuffled():
+    images, labels = _data()
+    nat = NativeDataLoader(images, labels, 16, shuffle=False)
+    py = DataLoader({"image": images, "label": labels}, 16, shuffle=False)
+    for nb, pb in zip(nat, py):
+        np.testing.assert_array_equal(nb["image"], pb["image"])
+        np.testing.assert_array_equal(nb["label"], pb["label"])
+    nat.close()
+
+
+def test_shuffle_is_deterministic_and_complete():
+    images, labels = _data()
+    labels = np.arange(64, dtype=np.int32)     # identify samples by label
+
+    def epoch_labels(loader, epoch):
+        loader.set_epoch(epoch)
+        return np.concatenate([b["label"] for b in loader])
+
+    a = NativeDataLoader(images, labels, 16, shuffle=True, seed=3)
+    b = NativeDataLoader(images, labels, 16, shuffle=True, seed=3)
+    e0a, e0b = epoch_labels(a, 0), epoch_labels(b, 0)
+    np.testing.assert_array_equal(e0a, e0b)    # same seed -> same order
+    assert sorted(e0a.tolist()) == list(range(64))  # a permutation
+    e1a = epoch_labels(a, 1)
+    assert not np.array_equal(e0a, e1a)        # epochs differ
+    a.close(); b.close()
+
+
+def test_normalization():
+    images, labels = _data(c=3)
+    mean, std = [0.5, 0.4, 0.3], [0.2, 0.3, 0.4]
+    nat = NativeDataLoader(images, labels, 16, shuffle=False,
+                           mean=mean, std=std)
+    batch = next(iter(nat))
+    expected = (images[:16] - np.asarray(mean, np.float32)) / \
+        np.asarray(std, np.float32)
+    np.testing.assert_allclose(batch["image"], expected, atol=1e-6)
+    nat.close()
+
+
+def test_augmentation_deterministic_and_valid():
+    images, labels = _data(n=32, h=8, w=8)
+    a = NativeDataLoader(images, labels, 8, shuffle=False, augment=True,
+                         seed=5)
+    b = NativeDataLoader(images, labels, 8, shuffle=False, augment=True,
+                         seed=5)
+    ba, bb = next(iter(a)), next(iter(b))
+    np.testing.assert_array_equal(ba["image"], bb["image"])
+    # augmented but same label order
+    np.testing.assert_array_equal(ba["label"], labels[:8])
+    assert not np.array_equal(ba["image"], images[:8])
+    a.close(); b.close()
+
+
+def test_multiple_epochs_and_len():
+    images, labels = _data(n=50)
+    nat = NativeDataLoader(images, labels, 16, shuffle=True)
+    assert len(nat) == 3                       # drop_last
+    for epoch in range(3):
+        nat.set_epoch(epoch)
+        assert sum(1 for _ in nat) == 3
+    nat.close()
+
+
+def test_idx_native_reader(tmp_path):
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (10, 4, 4)).astype(np.uint8)
+    labels = rng.integers(0, 10, 10).astype(np.uint8)
+
+    def write_idx(path, arr, gz):
+        header = struct.pack(">HBB", 0, 0x08, arr.ndim) + \
+            struct.pack(">" + "I" * arr.ndim, *arr.shape)
+        blob = header + arr.tobytes()
+        if gz:
+            with gzip.open(path, "wb") as f:
+                f.write(blob)
+        else:
+            with open(path, "wb") as f:
+                f.write(blob)
+
+    for gz, suffix in ((True, ".gz"), (False, "")):
+        ip = str(tmp_path / f"im.idx3-ubyte{suffix}")
+        lp = str(tmp_path / f"lb.idx1-ubyte{suffix}")
+        write_idx(ip, images, gz)
+        write_idx(lp, labels, gz)
+        out_i = read_idx_native(ip)
+        out_l = read_idx_native(lp)
+        np.testing.assert_allclose(out_i, images.astype(np.float32) / 255.0,
+                                   atol=1e-6)
+        np.testing.assert_array_equal(out_l, labels.astype(np.int32))
+
+
+def test_topology_probe():
+    t = native.topology()
+    assert t["native"] is True
+    assert t["cpus"] >= 1
+    assert t["host"]
+
+
+def test_or_python_fallback(monkeypatch):
+    monkeypatch.setenv("DTDL_DISABLE_NATIVE", "1")
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", False)
+    images, labels = _data()
+    loader = NativeDataLoader.or_python(images, labels, 16, shuffle=False)
+    assert isinstance(loader, DataLoader)
+    batch = next(iter(loader))
+    np.testing.assert_array_equal(batch["image"], images[:16])
