@@ -39,6 +39,25 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _vma_of(*arrays):
+    """Union of manual (shard_map) varying axes across inputs.
+
+    Pallas out_shapes must declare how outputs vary when the kernel runs
+    inside shard_map (e.g. under the DataParallel strategy); outside
+    shard_map this is empty and the plain ShapeDtypeStruct path is used.
+    """
+    vma = set()
+    for a in arrays:
+        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
+    return tuple(sorted(vma))
+
+
+def _sds(shape, dtype, vma):
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _zero_pad_rows(x, block_start, valid_total):
     """Zero rows past the logical array end in a ragged tail tile.
 
@@ -152,8 +171,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+            _sds((bh, sq, d), q.dtype, _vma_of(q, k, v)),
+            _sds((bh, 1, sq), jnp.float32, _vma_of(q, k, v)),
         ],
         scratch_shapes=_scratch(block_q, d),
         interpret=_use_interpret(),
@@ -302,7 +321,7 @@ def _bwd(scale, causal, block_q, block_k, res, do_4d):
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=_sds((bh, sq, d), q.dtype, _vma_of(q, k, v, do)),
         scratch_shapes=[_scratch(block_q, d)[2]],
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
@@ -326,8 +345,8 @@ def _bwd(scale, causal, block_q, block_k, res, do_4d):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            _sds((bh, sk, d), k.dtype, _vma_of(q, k, v, do)),
+            _sds((bh, sk, d), v.dtype, _vma_of(q, k, v, do)),
         ],
         scratch_shapes=[
             _scratch(block_k, d)[2], _scratch(block_k, d)[2],

@@ -1,6 +1,6 @@
 from dtdl_tpu.train.state import TrainState, init_state  # noqa: F401
 from dtdl_tpu.train.step import (  # noqa: F401
-    make_train_step, make_eval_step, make_predict_step,
+    make_train_step, make_eval_step, make_predict_step, make_lm_train_step,
 )
 from dtdl_tpu.train.loop import train_epoch, evaluate  # noqa: F401
 from dtdl_tpu.train.trainer import (  # noqa: F401

@@ -106,6 +106,50 @@ def make_eval_step(strategy: Strategy | None = None,
     return strategy.compile_eval(evaluate)
 
 
+def make_lm_train_step(strategy: Strategy | None = None):
+    """Compiled causal-LM step ``(state, batch) -> (state, metrics)``.
+
+    ``batch``: {'tokens': int32 [B, S]} (optionally 'mask' f32 [B, S-1] over
+    *target* positions).  Next-token cross entropy with shift; metrics are
+    globally averaged {'loss', 'accuracy'} like the classifier step.
+    """
+    strategy = strategy or SingleDevice()
+
+    def step(state: TrainState, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(targets.shape, jnp.float32)
+        # Global token count, so shards with sparser masks weigh less —
+        # keeping the sharded loss/grads identical to single-device.  Each
+        # replica's loss is scaled by num_replicas so grad_sync's *mean*
+        # reconstructs the global sum/N exactly.
+        total = strategy.sum_sync(mask.sum())
+        scale = strategy.num_replicas / jnp.maximum(total, 1.0)
+
+        def compute_loss(params):
+            logits = state.apply_fn({"params": params}, inputs, train=True)
+            logits = logits.astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            true = jnp.take_along_axis(
+                logits, targets[..., None].astype(jnp.int32), -1)[..., 0]
+            return jnp.sum((lse - true) * mask) * scale, logits
+
+        (loss, logits), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(strategy.localize(state.params))
+        grads = strategy.grad_sync(grads)
+        new_state = state.apply_gradients(grads=grads, batch_stats=None)
+        correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+        metrics = strategy.metric_sync({
+            "loss": loss,
+            "accuracy": jnp.sum(correct * mask) * scale,
+        })
+        return new_state, metrics
+
+    return strategy.compile(step)
+
+
 def make_predict_step(strategy: Strategy | None = None,
                       probabilities: bool = False):
     """Compiled inference step ``(state, batch) -> logits/probs``.

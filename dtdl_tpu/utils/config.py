@@ -59,7 +59,7 @@ def add_train_flags(parser, batch_size=64, lr=0.1, epochs=20, momentum=0.9,
 
 def add_data_flags(parser, dataset="mnist"):
     flag(parser, "--dataset", type=str, default=dataset,
-         choices=["mnist", "cifar10", "synthetic"])
+         choices=["mnist", "cifar10", "synthetic", "synthetic_lm"])
     flag(parser, "--dataset-dir", "--dataset_dir", type=str, default="./datasets",
          help="root containing mnist/*.gz or cifar-10 batches; synthetic "
               "data is generated deterministically when files are absent")
@@ -99,6 +99,12 @@ def add_topology_flags(parser):
     flag(parser, "--job-name", type=str, default="worker",
          help="'worker' (PS mode is routed to collective data parallelism)")
     flag(parser, "--task-index", type=int, default=0)
+    flag(parser, "--platform", type=str, default="",
+         help="force a JAX platform ('cpu' for local dry runs); the default "
+              "uses the environment's platform (the TPU backend here)")
+    flag(parser, "--fake-devices", type=int, default=0,
+         help="with --platform cpu: number of virtual CPU devices (the "
+              "multi-chip dry-run mode, SURVEY §4)")
 
 
 def parse_mesh_shape(args) -> tuple[tuple[int, ...], tuple[str, ...]] | None:

@@ -28,7 +28,16 @@ from dtdl_tpu.utils.config import parse_mesh_shape
 
 
 def bootstrap(args):
-    """Rendezvous (if multi-process) and print the leader banner."""
+    """Rendezvous (if multi-process) and print the leader banner.
+
+    ``--platform cpu --fake-devices 8`` switches to a virtual CPU mesh via
+    jax.config — env vars are too late here because this environment's
+    sitecustomize initializes the TPU backend at interpreter start.
+    """
+    if getattr(args, "platform", ""):
+        jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu" and getattr(args, "fake_devices", 0):
+            jax.config.update("jax_num_cpu_devices", args.fake_devices)
     initialize(coordinator=getattr(args, "coordinator", ""),
                num_processes=getattr(args, "num_processes", 1),
                process_id=getattr(args, "process_id", 0))

@@ -108,3 +108,25 @@ def test_single_device_example_tiny(tmp_path):
         timeout=900)
     assert "Epoch [0]" in out
     assert (tmp_path / "out" / "pyramidnet_final.msgpack").exists()
+
+
+def test_train_lm_example(tmp_path):
+    """DP causal-LM training decreases loss on the Markov synthetic task."""
+    out = run_example(
+        "train_lm.py", "--epochs", "1", "--batch-size", "32",
+        "--seq-len", "64", "--model-size", "tiny",
+        "--out", str(tmp_path / "out"))
+    losses = [float(m) for m in re.findall(r"loss: ([\d.]+)", out)]
+    assert len(losses) >= 3, out
+    assert losses[-1] < losses[0], losses
+    assert (tmp_path / "out" / "lm_final.msgpack").exists()
+
+
+def test_train_lm_4d_example(tmp_path):
+    """Full dp/sp/pp/tp+ep step over a 1,2,2,1 mesh (4 fake devices)."""
+    out = run_example(
+        "train_lm_4d.py", "--steps", "3", "--batch-size", "8",
+        "--seq-len", "64", "--n-experts", "2", "--mesh", "1,2,2,1")
+    m = re.search(r"final loss ([\d.]+)", out)
+    assert m, out
+    assert float(m.group(1)) < 10.0
