@@ -22,16 +22,30 @@ from dtdl_tpu.utils.timing import StepTimer
 def train_epoch(train_step, state, loader, strategy: Strategy,
                 reporter: Reporter | None = None, epoch: int = 0,
                 log_interval: int = 20, timer: StepTimer | None = None,
-                prefetch: int = 2):
-    """Run one epoch; returns (state, epoch_mean_metrics)."""
+                prefetch: int = 2, profile_dir: str | None = None):
+    """Run one epoch; returns (state, epoch_mean_metrics).
+
+    ``profile_dir`` captures a jax.profiler (XLA op-level) trace of the
+    epoch — the device-side observability the reference lacked (SURVEY §5.1).
+    """
+    from dtdl_tpu.utils.profiling import maybe_trace, step_annotation
     timer = timer or StepTimer()
     timer.reset_epoch()
     acc = Accumulator()
     loader.set_epoch(epoch)
     steps_per_epoch = len(loader)
     it = prefetch_to_device(iter(loader), strategy.shard_batch, prefetch)
+    ctx = maybe_trace(profile_dir)
+    with ctx:
+        return _run_epoch(train_step, state, it, timer, acc, reporter, epoch,
+                          steps_per_epoch, log_interval, step_annotation)
+
+
+def _run_epoch(train_step, state, it, timer, acc, reporter, epoch,
+               steps_per_epoch, log_interval, step_annotation):
     for i, batch in enumerate(it):
-        state, metrics = train_step(state, batch)
+        with step_annotation(i):
+            state, metrics = train_step(state, batch)
         timer.step(metrics["loss"])
         acc.add({k: float(v) for k, v in metrics.items()})
         if reporter is not None and (i % log_interval) == 0:

@@ -1,0 +1,44 @@
+"""Profiling — jax.profiler trace capture as a first-class hook.
+
+The reference's only tracing is wall-clock prints and external nvidia-smi
+screenshots (SURVEY §5.1: reference pytorch/distributed_data_parallel.py:
+122-152, imgs/pytorch/*_gpu.PNG).  Here the wall-clock side lives in
+dtdl_tpu.utils.timing.StepTimer; this module adds the device side: XLA
+profiler traces viewable in TensorBoard/Perfetto (op-level timelines, HBM
+usage, ICI collectives) captured around any training region.
+
+Usage::
+
+    from dtdl_tpu.utils.profiling import maybe_trace, step_annotation
+
+    with maybe_trace("/tmp/trace"):          # no-op when dir is falsy
+        for i, batch in enumerate(loader):
+            with step_annotation(i):          # groups ops per step
+                state, metrics = train_step(state, batch)
+
+``train_epoch(..., profile_dir=...)`` wires this for the standard loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def maybe_trace(logdir: str | None):
+    """Capture a jax.profiler trace into ``logdir`` (falsy = no-op)."""
+    if not logdir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def step_annotation(step: int):
+    """Label ops dispatched in this step inside an active trace.
+
+    Cheap when no trace is active, so the training loop can always use it.
+    """
+    import jax
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)

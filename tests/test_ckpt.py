@@ -91,3 +91,28 @@ def test_snapshot_restore_into_replicated_state(tmp_path, devices):
     dstep = make_train_step(strat)
     out, m = dstep(rstate, strat.shard_batch(batch(1)))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_sharded_4d_params_snapshot_roundtrip(tmp_path, devices):
+    """Orbax snapshot/restore of the megatron 4D-sharded param tree: each
+    leaf keeps its NamedSharding (pipe/model-sharded dims) across restore."""
+    import orbax.checkpoint as ocp
+    from dtdl_tpu.parallel import megatron as M
+
+    cfg = M.MegatronConfig(n_experts=4, dtype=jnp.float32)
+    mesh = M.build_4d_mesh(devices)
+    params = M.place_params(mesh, cfg, M.init_params(cfg, jax.random.PRNGKey(0)))
+
+    path = str(tmp_path / "snap")
+    with ocp.StandardCheckpointer() as ck:
+        ck.save(path, params)
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        params)
+    with ocp.StandardCheckpointer() as ck:
+        restored = ck.restore(path, abstract)
+
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        assert a.sharding == b.sharding
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
