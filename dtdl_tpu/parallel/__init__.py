@@ -3,6 +3,9 @@ from dtdl_tpu.parallel.strategy import (  # noqa: F401
     data_parallel_local, distributed_data_parallel, choose_strategy,
 )
 from dtdl_tpu.parallel import collectives  # noqa: F401
+from dtdl_tpu.parallel.kvstore import (  # noqa: F401
+    KVStore, KVStoreStrategy, kvstore_strategy,
+)
 from dtdl_tpu.parallel.sequence import (  # noqa: F401
     ring_attention, ulysses_attention,
 )

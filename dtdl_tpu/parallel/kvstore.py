@@ -1,0 +1,164 @@
+"""KVStore — the MXNet-idiom gradient-aggregation surface over XLA collectives.
+
+The reference declares an ``mxnet/`` track (reference README.md:4-20) that was
+never written (``mxnet/README.md`` is empty, SURVEY §2.1).  MXNet's canonical
+distributed idiom is the **key-value store**: workers ``push`` gradients keyed
+by parameter name, the store aggregates (sums) them — locally across devices
+for ``local``/``device`` stores, across machines via parameter servers for
+``dist_sync`` — and workers ``pull`` the aggregate back before the optimizer
+update.  This module is that capability rebuilt TPU-native:
+
+* ``push``/``pull`` inside a jitted SPMD step stage per-replica values and
+  aggregate them with ``lax.psum`` over the mesh's data axis — the XLA
+  AllReduce over ICI replaces the parameter-server hop entirely (there is no
+  server tier to place; the "store" is the collective).
+* ``dist_async`` is accepted and routed to synchronous aggregation, the same
+  accept-but-route treatment the reference gives TF's vestigial PS mode
+  (reference tensorflow2/mnist_multi_worker_strategy.py:15-16 rejects Ps;
+  SURVEY §2.2 says keep the flag surface, route to collective DP) — on a TPU
+  mesh the synchronous AllReduce is both faster and deterministic, so async
+  staleness buys nothing.
+* ``KVStoreStrategy`` plugs the store into the train-step engine as the
+  gradient-sync backend, which is exactly the role ``kvstore=`` plays in
+  ``mxnet.mod.Module.fit`` — the rest of the step (forward, backward, update)
+  is untouched.
+
+Like MXNet, aggregation is a **sum**; normalization is explicit —
+``pull(average=True)`` or a constructor ``rescale`` factor — mirroring how
+MXNet leaves it to the optimizer's ``rescale_grad=1/batch_size``.
+``KVStoreStrategy`` pulls averaged gradients, making it numerically identical
+to ``lax.pmean`` DDP.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from dtdl_tpu.parallel.strategy import DataParallel, SingleDevice, Strategy
+from dtdl_tpu.runtime.mesh import DATA_AXIS, build_mesh, local_mesh
+
+VALID_KINDS = ("local", "device", "dist_sync", "dist_device_sync", "dist_async")
+
+
+class KVStore:
+    """MXNet-style key-value store over a mesh axis.
+
+    Inside a traced SPMD step (under ``KVStoreStrategy.compile`` /
+    ``DataParallel.compile``), ``push`` stages per-replica pytrees and
+    ``pull`` returns the cross-replica sum (times ``rescale``).  Outside jit,
+    ``init``/``pull_init`` hold host-level initial values — MXNet's
+    ``kv.init(key, value)`` handshake where worker 0's value wins.
+    """
+
+    def __init__(self, kind: str = "local", axis: str = DATA_AXIS,
+                 mesh=None, rescale: float | None = None):
+        if kind not in VALID_KINDS:
+            raise ValueError(
+                f"unknown kvstore kind {kind!r}; one of {VALID_KINDS}")
+        self.kind = kind
+        self.axis = axis
+        if mesh is None:
+            mesh = (build_mesh() if kind.startswith("dist")
+                    else local_mesh())
+        self.mesh = mesh
+        self._staged: dict[str, object] = {}
+        self._init: dict[str, object] = {}
+        self.rescale = rescale
+
+    # ---- topology (MXNet kv.rank / kv.num_workers) -------------------------
+
+    @property
+    def rank(self) -> int:
+        """This worker *process*'s rank — MXNet's ``kv.rank`` is a process-
+        level id, pairing with ``num_workers`` for host-side data sharding
+        (``data[rank::num_workers]``)."""
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        """Number of worker *processes* (MXNet semantics: 1 for local/device
+        stores, the dist world size for dist_*).  Distinct from
+        ``aggregation_width`` — one TPU process drives many devices."""
+        return jax.process_count() if self.kind.startswith("dist") else 1
+
+    @property
+    def aggregation_width(self) -> int:
+        """Device replicas summed by push/pull: the store's mesh-axis size."""
+        return self.mesh.shape[self.axis]
+
+    @property
+    def distributed(self) -> bool:
+        return self.aggregation_width > 1
+
+    # ---- host-level init (outside jit) -------------------------------------
+
+    def init(self, key: str, value) -> None:
+        """Register an initial value; worker 0's copy wins across hosts."""
+        from dtdl_tpu.parallel.collectives import host_broadcast
+        self._init[key] = host_broadcast(value)
+
+    def pull_init(self, key: str):
+        return self._init[key]
+
+    # ---- traced push/pull (inside an SPMD step) ----------------------------
+
+    def push(self, key: str, value) -> None:
+        """Stage this replica's contribution for ``key``."""
+        self._staged[key] = value
+
+    def pull(self, key: str, average: bool = False):
+        """Aggregate the last pushed value across the store's replicas.
+
+        **Sum**-aggregation, the MXNet contract — normalization is the
+        caller's job there (optimizer ``rescale_grad``) and here it is the
+        constructor's ``rescale`` factor or ``average=True`` (divide by
+        ``aggregation_width``).  ``dist_async`` intentionally reaches the
+        same synchronous psum (see module docstring).
+        """
+        value = self._staged.pop(key)
+        if not self.distributed:
+            return value
+        summed = lax.psum(value, axis_name=self.axis)
+        scale = 1.0 / self.aggregation_width if average else \
+            (self.rescale if self.rescale is not None else 1.0)
+        if scale == 1.0:
+            return summed
+        return jax.tree.map(lambda g: g * scale, summed)
+
+    def push_pull(self, key: str, value, average: bool = False):
+        """One-shot push+pull (MXNet's fused ``pushpull``)."""
+        self.push(key, value)
+        return self.pull(key, average=average)
+
+class KVStoreStrategy(DataParallel):
+    """DataParallel whose gradient sync routes through a ``KVStore``.
+
+    This is ``kvstore=`` in ``Module.fit``: the store owns aggregation, the
+    strategy owns placement/compilation.  With a ``local``/``device`` store
+    the mesh is this process's devices (single-process multi-device, MXNet
+    ``ctx=[mx.gpu(0), mx.gpu(1)]``); with ``dist_*`` it spans all hosts.
+    """
+
+    def __init__(self, kv: KVStore):
+        super().__init__(kv.mesh, kv.axis)
+        self.kv = kv
+
+    def grad_sync(self, grads):
+        return self.kv.push_pull("grad", grads, average=True)
+
+
+def create(kind: str = "local", mesh=None, axis: str = DATA_AXIS) -> KVStore:
+    """``mxnet.kv.create`` equivalent."""
+    return KVStore(kind, axis=axis, mesh=mesh)
+
+
+def kvstore_strategy(kv: KVStore | str = "local", mesh=None) -> Strategy:
+    """Strategy for ``Module.fit(kvstore=...)``: SingleDevice when the store
+    spans one device, else KVStore-backed data parallelism.  Accepts an
+    existing store (the one you printed/initialized) or a kind string."""
+    if isinstance(kv, str):
+        kv = create(kv, mesh=mesh)
+    if kv.aggregation_width == 1:
+        return SingleDevice()
+    return KVStoreStrategy(kv)

@@ -35,26 +35,19 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
     loader.set_epoch(epoch)
     steps_per_epoch = len(loader)
     it = prefetch_to_device(iter(loader), strategy.shard_batch, prefetch)
-    ctx = maybe_trace(profile_dir)
-    with ctx:
-        return _run_epoch(train_step, state, it, timer, acc, reporter, epoch,
-                          steps_per_epoch, log_interval, step_annotation)
-
-
-def _run_epoch(train_step, state, it, timer, acc, reporter, epoch,
-               steps_per_epoch, log_interval, step_annotation):
-    for i, batch in enumerate(it):
-        with step_annotation(i):
-            state, metrics = train_step(state, batch)
-        timer.step(metrics["loss"])
-        acc.add({k: float(v) for k, v in metrics.items()})
-        if reporter is not None and (i % log_interval) == 0:
-            reporter.report({
-                "epoch": epoch, "step": i,
-                "steps_per_epoch": steps_per_epoch,
-                **{k: float(v) for k, v in metrics.items()},
-                "batch_time": timer.last_step_s,
-            })
+    with maybe_trace(profile_dir):
+        for i, batch in enumerate(it):
+            with step_annotation(i):
+                state, metrics = train_step(state, batch)
+            timer.step(metrics["loss"])
+            acc.add({k: float(v) for k, v in metrics.items()})
+            if reporter is not None and (i % log_interval) == 0:
+                reporter.report({
+                    "epoch": epoch, "step": i,
+                    "steps_per_epoch": steps_per_epoch,
+                    **{k: float(v) for k, v in metrics.items()},
+                    "batch_time": timer.last_step_s,
+                })
     if reporter is not None:
         reporter.report({
             "epoch": epoch, "split": "train_epoch",

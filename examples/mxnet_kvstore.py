@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""MNIST CNN via the MXNet idiom: Module.fit over a KVStore.
+
+The reference's ``mxnet/`` track is declared (reference README.md:4-20) but
+empty (``mxnet/README.md`` is zero-byte, SURVEY §2.1).  MXNet's canonical
+distributed-training shape — the one its own image-classification examples
+use — is::
+
+    kv  = mx.kv.create(args.kv_store)            # 'local'|'device'|'dist_sync'
+    mod = mx.mod.Module(symbol, context=ctxs)
+    mod.fit(train_iter, eval_data=val_iter, optimizer='sgd',
+            optimizer_params={'learning_rate': .1}, kvstore=kv,
+            batch_end_callback=mx.callback.Speedometer(batch, 100),
+            num_epoch=10)
+
+This script is that surface rebuilt TPU-native: the KVStore aggregates
+gradients with an XLA AllReduce over the mesh's data axis instead of a
+parameter-server tier (dtdl_tpu/parallel/kvstore.py), and Module.fit drives
+the jitted train-step engine.  ``--kv-store dist_async`` is accepted and
+routed to synchronous aggregation (see kvstore.py docstring).
+
+    python examples/mxnet_kvstore.py --kv-store device --batch-size 64
+    python examples/mxnet_kvstore.py --kv-store dist_sync \
+        --coordinator host:1234 --num-processes 2 --process-id 0
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from common import bootstrap, mnist_arrays, per_process_loader
+from dtdl_tpu.data.loader import prefetch_to_device
+from dtdl_tpu.metrics.report import Accumulator
+from dtdl_tpu.models import MnistCNN
+from dtdl_tpu.parallel.kvstore import create as kv_create, kvstore_strategy
+from dtdl_tpu.train import init_state, make_eval_step, make_train_step
+from dtdl_tpu.train.loop import evaluate
+from dtdl_tpu.utils import seed_everything
+from dtdl_tpu.utils.config import (add_ckpt_flags, add_data_flags,
+                                   add_topology_flags, flag, make_parser)
+
+
+class Speedometer:
+    """MXNet's batch_end_callback: periodic samples/sec + metric line.
+
+    Resets its window at every epoch boundary (like MXNet's) so validation
+    and epoch-summary time never pollute a measurement window.
+    """
+
+    def __init__(self, batch_size: int, frequent: int = 50):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._epoch = -1
+
+    def __call__(self, epoch: int, nbatch: int, metrics: dict) -> None:
+        if epoch != self._epoch:
+            self._epoch = epoch
+            self.tic = time.time()
+            self.count = 0
+        self.count += 1
+        if self.count % self.frequent:
+            return
+        speed = self.frequent * self.batch_size / (time.time() - self.tic)
+        line = "\t".join(f"{k}={v:.6f}" for k, v in metrics.items())
+        print(f"Epoch[{epoch}] Batch [{nbatch}]\tSpeed: {speed:.2f} "
+              f"samples/sec\t{line}", flush=True)
+        self.tic = time.time()
+
+
+class Module:
+    """MXNet Module-flavored wrapper: symbol + context → fit()."""
+
+    def __init__(self, symbol, strategy):
+        self.symbol = symbol
+        self.strategy = strategy
+        self.state = None
+
+    def fit(self, train_loader, eval_loader=None, optimizer="sgd",
+            optimizer_params=None, num_epoch: int = 10,
+            batch_end_callback=None, seed: int = 0):
+        params = dict(optimizer_params or {})
+        lr = params.pop("learning_rate", 0.01)
+        momentum = params.pop("momentum", 0.0)
+        wd = params.pop("wd", 0.0)
+        if optimizer == "sgd":
+            tx = optax.chain(optax.add_decayed_weights(wd),
+                             optax.sgd(lr, momentum=momentum or None))
+        elif optimizer == "adam":
+            tx = optax.adam(lr)
+        else:
+            raise ValueError(f"unsupported optimizer {optimizer!r}")
+
+        key = seed_everything(seed)
+        self.state = self.strategy.replicate(init_state(
+            self.symbol, key, jnp.zeros((1, 28, 28, 1)), tx))
+        train_step = make_train_step(self.strategy)
+        eval_step = make_eval_step(self.strategy)
+
+        for epoch in range(num_epoch):
+            train_loader.set_epoch(epoch)
+            acc = Accumulator()
+            tic = time.time()
+            it = prefetch_to_device(iter(train_loader),
+                                    self.strategy.shard_batch)
+            for nbatch, batch in enumerate(it):
+                self.state, metrics = train_step(self.state, batch)
+                metrics = {k: float(v) for k, v in metrics.items()}
+                acc.add(metrics)
+                if batch_end_callback is not None:
+                    batch_end_callback(epoch, nbatch, metrics)
+            means = acc.means()
+            print(f"Epoch[{epoch}] Train-accuracy={means['accuracy']:.6f}")
+            print(f"Epoch[{epoch}] Time cost={time.time() - tic:.3f}")
+            if eval_loader is not None:
+                val = evaluate(eval_step, self.state, eval_loader,
+                               self.strategy)
+                print(f"Epoch[{epoch}] Validation-accuracy="
+                      f"{val['accuracy']:.6f}", flush=True)
+        return self.state
+
+
+def main():
+    parser = make_parser("dtdl_tpu: MXNet-style Module.fit over a KVStore")
+    flag(parser, "--kv-store", type=str, default="device",
+         choices=["local", "device", "dist_sync", "dist_device_sync",
+                  "dist_async"])
+    flag(parser, "-b", "--batch-size", type=int, default=64,
+         help="GLOBAL batch size")
+    flag(parser, "--lr", type=float, default=0.05)
+    flag(parser, "--momentum", type=float, default=0.9)
+    flag(parser, "--num-epochs", "--epochs", type=int, default=3)
+    flag(parser, "--disp-batches", type=int, default=50,
+         help="Speedometer frequency")
+    flag(parser, "--seed", type=int, default=0)
+    add_data_flags(parser, dataset="mnist")
+    add_ckpt_flags(parser)
+    add_topology_flags(parser)
+    args = parser.parse_args()
+    bootstrap(args)
+
+    kv = kv_create(args.kv_store)
+    strategy = kvstore_strategy(kv)
+    print(f"kvstore: kind={kv.kind} rank={kv.rank} "
+          f"num_workers={kv.num_workers} width={kv.aggregation_width}",
+          flush=True)
+
+    (x, y), (vx, vy) = mnist_arrays(args)
+    train_loader = per_process_loader(x, y, args.batch_size, shuffle=True,
+                                      seed=args.seed)
+    val_loader = per_process_loader(vx, vy, args.batch_size, shuffle=False,
+                                    seed=args.seed, drop_last=False)
+
+    mod = Module(MnistCNN(), strategy)
+    state = mod.fit(train_loader, eval_loader=val_loader, optimizer="sgd",
+                    optimizer_params={"learning_rate": args.lr,
+                                      "momentum": args.momentum},
+                    num_epoch=args.num_epochs,
+                    batch_end_callback=Speedometer(args.batch_size,
+                                                   args.disp_batches),
+                    seed=args.seed)
+
+    if args.save_model:
+        # leader-gating + cross-host barrier live inside save_weights
+        from dtdl_tpu.ckpt import save_weights
+        save_weights(f"{args.out}/mxnet_cnn.msgpack", state.params)
+
+
+if __name__ == "__main__":
+    main()

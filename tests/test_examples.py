@@ -110,6 +110,18 @@ def test_single_device_example_tiny(tmp_path):
     assert (tmp_path / "out" / "pyramidnet_final.msgpack").exists()
 
 
+def test_mxnet_kvstore_example(tmp_path):
+    """MXNet-idiom Module.fit over a dist_sync KVStore (4 fake devices)."""
+    out = run_example(
+        "mxnet_kvstore.py", "--kv-store", "dist_sync", "--batch-size", "64",
+        "--num-epochs", "1", "--limit-train", "512", "--limit-test", "256",
+        "--dataset-dir", str(tmp_path / "none"), "--out", str(tmp_path / "o"))
+    assert "kvstore: kind=dist_sync rank=0 num_workers=1 width=4" in out
+    m = re.search(r"Validation-accuracy=([\d.]+)", out)
+    assert m, out
+    assert (tmp_path / "o" / "mxnet_cnn.msgpack").exists()
+
+
 def test_train_lm_example(tmp_path):
     """DP causal-LM training decreases loss on the Markov synthetic task."""
     out = run_example(

@@ -1,0 +1,117 @@
+"""KVStore (MXNet-idiom) tests.
+
+Correctness property: KVStore-backed gradient sync must be numerically
+identical to pmean DDP (the store is sum+rescale over the same mesh axis),
+and therefore to large-batch single-device training for BN-free models.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dtdl_tpu.models import MLP
+from dtdl_tpu.parallel import DataParallel, SingleDevice
+from dtdl_tpu.parallel.kvstore import (KVStore, KVStoreStrategy, create,
+                                       kvstore_strategy)
+from dtdl_tpu.train import init_state, make_train_step
+
+
+def make_mlp_state(seed=0):
+    return init_state(MLP(n_units=32), jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 784)), optax.sgd(0.1))
+
+
+def fake_batch(rng, n):
+    return {
+        "image": jnp.asarray(rng.normal(size=(n, 784)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(n,))),
+    }
+
+
+def test_create_validates_kind():
+    with pytest.raises(ValueError):
+        create("dist_banana")
+
+
+def test_topology(devices):
+    kv = create("device")
+    # num_workers/rank are process-level (MXNet semantics: local stores
+    # report 1 worker); aggregation_width is the device-replica count.
+    assert kv.num_workers == 1
+    assert kv.rank == 0
+    assert kv.aggregation_width == 8
+    assert kv.distributed
+    assert create("dist_sync").num_workers == jax.process_count()
+
+
+def test_push_pull_sum_and_average(devices):
+    """pull sums across workers; average=True divides by num_workers."""
+    kv = create("dist_sync")
+
+    def body(x):
+        s = kv.push_pull("k", x)  # default: SUM (the MXNet contract)
+        kv.push("k", x)
+        m = kv.pull("k", average=True)
+        return s, m
+
+    mapped = jax.jit(jax.shard_map(
+        body, mesh=kv.mesh, in_specs=P("data"), out_specs=P("data")))
+    x = jnp.arange(8, dtype=jnp.float32)
+    summed, mean = mapped(x)
+    np.testing.assert_allclose(np.asarray(summed), np.full(8, 28.0))
+    np.testing.assert_allclose(np.asarray(mean), np.full(8, 3.5))
+
+
+def test_kvstore_strategy_matches_ddp(devices):
+    """A KVStore-synced step is bitwise-comparable to pmean DDP."""
+    rng = np.random.default_rng(0)
+    batch = fake_batch(rng, 32)
+
+    ddp = DataParallel()
+    kvs = KVStoreStrategy(create("dist_sync"))
+    assert kvs.num_replicas == 8
+
+    d_state = ddp.replicate(make_mlp_state())
+    k_state = kvs.replicate(make_mlp_state())
+    d_step = make_train_step(ddp)
+    k_step = make_train_step(kvs)
+    for _ in range(3):
+        d_state, dm = d_step(d_state, ddp.shard_batch(batch))
+        k_state, km = k_step(k_state, kvs.shard_batch(batch))
+
+    np.testing.assert_allclose(float(dm["loss"]), float(km["loss"]), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        jax.device_get(d_state.params), jax.device_get(k_state.params))
+
+
+def test_dist_async_routes_to_sync(devices):
+    """dist_async is accepted and reaches the same synchronous psum."""
+    rng = np.random.default_rng(1)
+    batch = fake_batch(rng, 16)
+    sync = KVStoreStrategy(create("dist_sync"))
+    asyn = KVStoreStrategy(create("dist_async"))
+    s_state = sync.replicate(make_mlp_state())
+    a_state = asyn.replicate(make_mlp_state())
+    s_state, sm = make_train_step(sync)(s_state, sync.shard_batch(batch))
+    a_state, am = make_train_step(asyn)(a_state, asyn.shard_batch(batch))
+    assert float(sm["loss"]) == float(am["loss"])
+
+
+def test_kvstore_strategy_single_worker_falls_back():
+    """A 1-device store needs no collectives — SingleDevice semantics."""
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:1]).reshape((1,)), ("data",))
+    strat = kvstore_strategy("local", mesh=mesh)
+    assert isinstance(strat, SingleDevice)
+
+
+def test_host_init_roundtrip():
+    kv = KVStore("local")
+    kv.init("w", {"a": jnp.ones((2,))})
+    out = kv.pull_init("w")
+    np.testing.assert_allclose(np.asarray(out["a"]), np.ones((2,)))
