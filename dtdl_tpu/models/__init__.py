@@ -12,6 +12,7 @@ from dtdl_tpu.models.resnet import ResNet, ResNet50, resnet50  # noqa: F401
 from dtdl_tpu.models.transformer import (  # noqa: F401
     TransformerLM, transformer_lm,
 )
+from dtdl_tpu.models.netspec import CaffeNet, build_net  # noqa: F401
 
 _REGISTRY = {
     "mlp": lambda **kw: MLP(**kw),

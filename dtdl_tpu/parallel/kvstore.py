@@ -117,9 +117,10 @@ class KVStore:
         same synchronous psum (see module docstring).
         """
         value = self._staged.pop(key)
-        if not self.distributed:
-            return value
-        summed = lax.psum(value, axis_name=self.axis)
+        # width-1 store: the sum is the value itself, but rescale/average
+        # must still apply — same numerics on 1 device as on N.
+        summed = (lax.psum(value, axis_name=self.axis) if self.distributed
+                  else value)
         scale = 1.0 / self.aggregation_width if average else \
             (self.rescale if self.rescale is not None else 1.0)
         if scale == 1.0:

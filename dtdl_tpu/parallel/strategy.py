@@ -64,6 +64,10 @@ class Strategy:
     def stats_sync(self, tree):
         return tree
 
+    def fold_rank(self, key):
+        """Decorrelate an rng across replicas (identity off-mesh)."""
+        return key
+
     def compile(self, step_fn, donate_state: bool = True):
         """Jit a step ``(state, batch, ...) -> (state, metrics)``."""
         return jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
@@ -158,6 +162,10 @@ class DataParallel(MeshStrategy):
 
     def stats_sync(self, tree):
         return collectives.all_reduce_mean(tree, self.axis)
+
+    def fold_rank(self, key):
+        # each replica draws its own dropout mask, like per-rank DDP workers
+        return jax.random.fold_in(key, jax.lax.axis_index(self.axis))
 
     def compile(self, step_fn, donate_state: bool = True):
         mapped = jax.shard_map(

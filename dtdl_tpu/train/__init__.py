@@ -10,3 +10,4 @@ from dtdl_tpu.train.trainer import (  # noqa: F401
 from dtdl_tpu.train.fit import (  # noqa: F401
     Model, Callback, History, ModelCheckpoint, TensorBoard, PrintLR,
 )
+from dtdl_tpu.train.solver import Solver  # noqa: F401

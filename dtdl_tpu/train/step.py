@@ -26,34 +26,50 @@ from dtdl_tpu.parallel.strategy import Strategy, SingleDevice
 from dtdl_tpu.train.state import TrainState
 
 
-def _forward(state: TrainState, params, batch, train: bool):
+def _forward(state: TrainState, params, batch, train: bool, rngs=None):
     """Run the model, handling BatchNorm mutability uniformly."""
     x = batch["image"]
     if state.batch_stats is not None:
         variables = {"params": params, "batch_stats": state.batch_stats}
         if train:
             logits, updates = state.apply_fn(
-                variables, x, train=True, mutable=["batch_stats"])
+                variables, x, train=True, mutable=["batch_stats"],
+                rngs=rngs)
             return logits, updates["batch_stats"]
         return state.apply_fn(variables, x, train=False), None
-    logits = state.apply_fn({"params": params}, x, train=train)
+    logits = state.apply_fn({"params": params}, x, train=train, rngs=rngs)
     return logits, None
 
 
+def _dropout_rngs(state: TrainState, strategy: Strategy, seed: int):
+    """Per-step, per-replica dropout rng (flax ignores it if unused).
+
+    Deterministic in (seed, step); `fold_rank` decorrelates replicas the way
+    each DDP rank draws its own dropout mask.
+    """
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+    return {"dropout": strategy.fold_rank(key)}
+
+
 def make_train_step(strategy: Strategy | None = None,
-                    loss_fn: Callable = softmax_cross_entropy):
+                    loss_fn: Callable = softmax_cross_entropy,
+                    seed: int = 0):
     """Build the compiled step ``(state, batch) -> (state, metrics)``.
 
     ``batch`` is a dict with ``image`` (global batch, leading dim sharded on
     the data axis by the strategy) and integer ``label``.  Metrics come back
     as globally averaged scalars (loss, accuracy) — what the reference prints
     every 20 steps (pytorch/distributed_data_parallel.py:144-148).
+    ``seed`` feeds the per-step dropout rng (for models that use dropout).
     """
     strategy = strategy or SingleDevice()
 
     def step(state: TrainState, batch):
+        rngs = _dropout_rngs(state, strategy, seed)
+
         def compute_loss(params):
-            logits, new_stats = _forward(state, params, batch, train=True)
+            logits, new_stats = _forward(state, params, batch, train=True,
+                                         rngs=rngs)
             return loss_fn(logits, batch["label"]), (logits, new_stats)
 
         # Under DataParallel, localize() marks params per-replica so the
@@ -106,7 +122,7 @@ def make_eval_step(strategy: Strategy | None = None,
     return strategy.compile_eval(evaluate)
 
 
-def make_lm_train_step(strategy: Strategy | None = None):
+def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0):
     """Compiled causal-LM step ``(state, batch) -> (state, metrics)``.
 
     ``batch``: {'tokens': int32 [B, S]} (optionally 'mask' f32 [B, S-1] over
@@ -128,8 +144,11 @@ def make_lm_train_step(strategy: Strategy | None = None):
         total = strategy.sum_sync(mask.sum())
         scale = strategy.num_replicas / jnp.maximum(total, 1.0)
 
+        rngs = _dropout_rngs(state, strategy, seed)
+
         def compute_loss(params):
-            logits = state.apply_fn({"params": params}, inputs, train=True)
+            logits = state.apply_fn({"params": params}, inputs, train=True,
+                                    rngs=rngs)
             logits = logits.astype(jnp.float32)
             lse = jax.nn.logsumexp(logits, axis=-1)
             true = jnp.take_along_axis(

@@ -1,0 +1,421 @@
+"""Caffe track: prototxt parser, net builder, solver (SURVEY §2.1 —
+reference caffe/README.md is an empty placeholder; north-star requires the
+track's canonical surface: solver prototxt + net prototxt + caffe train)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dtdl_tpu.data import DataLoader
+from dtdl_tpu.data.synthetic import class_pattern_images
+from dtdl_tpu.models.netspec import build_net, parse_net
+from dtdl_tpu.parallel import DataParallel, SingleDevice
+from dtdl_tpu.train.solver import Solver, lr_schedule, make_optimizer
+from dtdl_tpu.utils import prototxt
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "caffe")
+
+
+# ---- prototxt parser --------------------------------------------------------
+
+def test_prototxt_scalars_and_strings():
+    msg = prototxt.parse('''
+        net: "lenet.prototxt"   # trailing comment
+        base_lr: 0.01
+        max_iter: 10000
+        test_initialization: false
+        type: "SGD"
+    ''')
+    assert msg.net == "lenet.prototxt"
+    assert msg.base_lr == 0.01
+    assert msg.max_iter == 10000
+    assert msg.test_initialization is False
+    assert msg.type == "SGD"
+
+
+def test_prototxt_nested_repeated_and_enums():
+    msg = prototxt.parse('''
+        layer { name: "a" type: "Convolution"
+                convolution_param { num_output: 20 kernel_size: 5 } }
+        layer { name: "b" type: "Pooling"
+                pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+        stepvalue: 100
+        stepvalue: 200
+    ''')
+    layers = msg.getlist("layer")
+    assert [l.name for l in layers] == ["a", "b"]
+    assert layers[0].convolution_param.num_output == 20
+    assert layers[1].pooling_param.pool == "MAX"  # enum -> identifier
+    assert msg.getlist("stepvalue") == [100, 200]
+
+
+def test_prototxt_colon_optional_before_brace():
+    msg = prototxt.parse('param: { lr_mult: 1 } include { phase: TRAIN }')
+    assert msg.param.lr_mult == 1
+    assert msg.include.phase == "TRAIN"
+
+
+@pytest.mark.parametrize("bad", ["layer {", "}", "name:", "42", "a: { b: }",
+                                 'prefix: "unterminated'])
+def test_prototxt_errors(bad):
+    with pytest.raises(ValueError):
+        prototxt.parse(bad)
+
+
+# ---- lr policies (closed-form checks) ---------------------------------------
+
+def _policy(text):
+    return lr_schedule(prototxt.parse(text))
+
+
+@pytest.mark.parametrize("text,it,expect", [
+    ('base_lr: 0.1 lr_policy: "fixed"', 500, 0.1),
+    ('base_lr: 0.1 lr_policy: "step" gamma: 0.5 stepsize: 100', 250, 0.025),
+    ('base_lr: 0.1 lr_policy: "exp" gamma: 0.99', 10, 0.1 * 0.99 ** 10),
+    ('base_lr: 0.01 lr_policy: "inv" gamma: 0.0001 power: 0.75', 1000,
+     0.01 * (1 + 0.0001 * 1000) ** -0.75),
+    ('base_lr: 0.1 lr_policy: "multistep" gamma: 0.1 stepvalue: 10 '
+     'stepvalue: 20', 15, 0.01),
+    ('base_lr: 0.1 lr_policy: "poly" power: 1.0 max_iter: 100', 25, 0.075),
+])
+def test_lr_policies(text, it, expect):
+    np.testing.assert_allclose(float(_policy(text)(jnp.asarray(it))),
+                               expect, rtol=1e-5)
+
+
+def test_sigmoid_policy_midpoint():
+    f = _policy('base_lr: 0.2 lr_policy: "sigmoid" gamma: 0.1 stepsize: 50')
+    np.testing.assert_allclose(float(f(jnp.asarray(50))), 0.1, rtol=1e-5)
+
+
+def test_adam_honors_explicit_zero_momentum():
+    """'momentum: 0.0' is a valid Caffe config (beta1=0), not 'use default'."""
+    tx0 = make_optimizer(prototxt.parse(
+        'base_lr: 0.1 momentum: 0.0 type: "Adam"'))
+    txd = make_optimizer(prototxt.parse('base_lr: 0.1 type: "Adam"'))
+    params = {"w": jnp.ones((4,))}
+    g = {"w": jnp.full((4,), 0.5)}
+    s0, sd = tx0.init(params), txd.init(params)
+    # two updates: with b1=0 the first moment is just the last gradient,
+    # so differing gradient histories must produce different updates vs b1=0.9
+    for gi in (g, {"w": jnp.full((4,), -0.5)}):
+        u0, s0 = tx0.update(gi, s0, params)
+        ud, sd = txd.update(gi, sd, params)
+    assert not np.allclose(np.asarray(u0["w"]), np.asarray(ud["w"]))
+
+
+def test_global_pooling():
+    text = '''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "pool" type: "Pooling" bottom: "data" top: "pool"
+              pooling_param { pool: AVE global_pooling: true } }
+    '''
+    net = build_net(text)
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((2, 7, 5, 3)))
+    x = jnp.arange(2 * 7 * 5 * 3, dtype=jnp.float32).reshape((2, 7, 5, 3))
+    out = net.apply(variables, x)
+    assert out.shape == (2, 1, 1, 3)
+    np.testing.assert_allclose(np.asarray(out)[:, 0, 0, :],
+                               np.asarray(x).mean(axis=(1, 2)), rtol=1e-5)
+
+
+def test_grouped_and_dilated_convolution():
+    text = '''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+              convolution_param { num_output: 8 kernel_size: 3 pad: 2
+                                  group: 2 dilation: 2 } }
+    '''
+    net = build_net(text)
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 4)))
+    # grouped kernel: input channels / group = 2
+    assert variables["params"]["conv"]["kernel"].shape == (3, 3, 2, 8)
+    out = net.apply(variables, jnp.ones((1, 8, 8, 4)))
+    assert out.shape == (1, 8, 8, 8)  # pad 2 with dilation 2 keeps size
+
+
+def test_snapshot_prefix_namespaces(tmp_path, devices):
+    """Two solvers with different prefixes in one dir must not clobber."""
+    train, test = _loaders()
+    net = tmp_path / "net.prototxt"
+    net.write_text(TINY_NET)
+    solvers = []
+    for name in ("lenet", "alexnet"):
+        sfile = tmp_path / f"{name}.prototxt"
+        sfile.write_text(f'''
+          net: "net.prototxt" base_lr: 0.1 lr_policy: "fixed"
+          max_iter: 4 snapshot: 4 random_seed: 1
+          snapshot_prefix: "{tmp_path}/result/{name}"
+        ''')
+        s = Solver(str(sfile), train, test, strategy=SingleDevice())
+        s.solve()
+        solvers.append(s)
+    assert solvers[0].out != solvers[1].out
+    for s in solvers:
+        s2 = Solver(str(tmp_path / "lenet.prototxt"), train, test,
+                    strategy=SingleDevice(), out=s.out)
+        assert s2.restore() and s2.iteration == 4
+
+
+@pytest.mark.parametrize("kind", ["SGD", "Nesterov", "Adam", "AdaGrad",
+                                  "RMSProp", "AdaDelta"])
+def test_solver_types_build_and_step(kind):
+    tx = make_optimizer(prototxt.parse(
+        f'base_lr: 0.01 momentum: 0.9 weight_decay: 0.0001 type: "{kind}"'))
+    params = {"w": jnp.ones((4, 4))}
+    opt_state = tx.init(params)
+    updates, _ = tx.update({"w": jnp.full((4, 4), 0.5)}, opt_state, params)
+    assert jnp.all(jnp.isfinite(updates["w"]))
+
+
+# ---- net builder ------------------------------------------------------------
+
+def test_lenet_prototxt_builds_and_runs():
+    net = build_net(os.path.join(EXAMPLES, "lenet_train_test.prototxt"))
+    specs = parse_net(prototxt.parse(net.net_text))
+    assert [s.type for s in specs[:3]] == ["Data", "Data", "Convolution"]
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((2, 28, 28, 1)))
+    # conv1: 20 filters of 5x5x1; ip1: (4*4*50) -> 500
+    assert variables["params"]["conv1"]["kernel"].shape == (5, 5, 1, 20)
+    assert variables["params"]["ip1"]["kernel"].shape == (800, 500)
+    logits = net.apply(variables, jnp.zeros((2, 28, 28, 1)))
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_net_phase_filtering_dropout():
+    text = '''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+              inner_product_param { num_output: 8 } }
+      layer { name: "drop" type: "Dropout" bottom: "ip" top: "ip"
+              dropout_param { dropout_ratio: 0.5 } include { phase: TRAIN } }
+      layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+    '''
+    net = build_net(text)
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((4, 16)))
+    x = jnp.ones((4, 16))
+    # TEST phase: no dropout, deterministic
+    a = net.apply(variables, x, train=False)
+    b = net.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # TRAIN phase: dropout active, needs rng, changes values
+    c = net.apply(variables, x, train=True,
+                  rngs={"dropout": jax.random.PRNGKey(1)})
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+
+def test_net_lrn_and_ave_pool():
+    text = '''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+              convolution_param { num_output: 8 kernel_size: 3 pad: 1 } }
+      layer { name: "norm" type: "LRN" bottom: "conv" top: "norm"
+              lrn_param { local_size: 3 alpha: 0.0001 beta: 0.75 } }
+      layer { name: "pool" type: "Pooling" bottom: "norm" top: "pool"
+              pooling_param { pool: AVE kernel_size: 2 stride: 2 } }
+      layer { name: "ip" type: "InnerProduct" bottom: "pool" top: "ip"
+              inner_product_param { num_output: 10 } }
+    '''
+    net = build_net(text)
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((2, 8, 8, 3)))
+    out = net.apply(variables, jnp.ones((2, 8, 8, 3)))
+    assert out.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_lrn_matches_naive():
+    from dtdl_tpu.models.netspec import _lrn
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 4, 4, 7)), jnp.float32)
+    size, alpha, beta, k = 3, 0.1, 0.75, 2.0
+    got = np.asarray(_lrn(x, size, alpha, beta, k))
+    xn = np.asarray(x)
+    half = size // 2
+    want = np.empty_like(xn)
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + half + 1)
+        win = np.sum(np.square(xn[..., lo:hi]), axis=-1)
+        want[..., c] = xn[..., c] / np.power(k + alpha / size * win, beta)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_build_net_rejects_empty():
+    with pytest.raises(ValueError):
+        build_net('name: "empty"')
+
+
+@pytest.mark.parametrize("H,k,s,p,expect", [
+    (32, 3, 2, 0, 16),   # CIFAR-quick pool1: ceil((32-3)/2)+1 = 16 (floor=15)
+    (28, 2, 2, 0, 14),   # LeNet pool: exact division, ceil == floor
+    (6, 3, 2, 1, 4),     # padded: ceil((6+2-3)/2)+1 = 4 (clip rule no-op)
+    (5, 3, 3, 1, 2),     # clip rule fires: 3rd window would start at 6 >= 5+1
+])
+def test_caffe_pool_ceil_geometry(H, k, s, p, expect):
+    from dtdl_tpu.models.netspec import _caffe_pool_pad
+    lo, hi = _caffe_pool_pad(H, k, s, p)
+    assert lo == p
+    # VALID pooling over the padded extent yields the Caffe output size
+    assert (H + lo + hi - k) // s + 1 == expect
+
+
+def test_ave_pool_edge_divisor_matches_caffe():
+    """AVE pool with ceil overhang: edge windows divide by the divisor
+    clipped to H+pad (Caffe's rule), so pooling all-ones gives all-ones."""
+    text = '''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "pool" type: "Pooling" bottom: "data" top: "pool"
+              pooling_param { pool: AVE kernel_size: 3 stride: 2 } }
+    '''
+    net = build_net(text)
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 6, 6, 2)))
+    out = net.apply(variables, jnp.ones((1, 6, 6, 2)))
+    assert out.shape == (1, 3, 3, 2)  # ceil((6-3)/2)+1
+    np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-6)
+
+
+def test_solver_split_train_test_nets(tmp_path, devices):
+    """test_net names a separate graph; weights are shared by layer name."""
+    (tmp_path / "train.prototxt").write_text(TINY_NET)
+    # test net: same layers (same names/shapes) plus a TEST-only Accuracy
+    (tmp_path / "test.prototxt").write_text(TINY_NET + '''
+      layer { name: "acc" type: "Accuracy" bottom: "ip2" bottom: "label"
+              include { phase: TEST } }
+    ''')
+    (tmp_path / "solver.prototxt").write_text(f'''
+      train_net: "train.prototxt"
+      test_net: "test.prototxt"
+      base_lr: 0.1 momentum: 0.9 lr_policy: "fixed"
+      max_iter: 20 random_seed: 3
+      snapshot_prefix: "{tmp_path}/tiny"
+    ''')
+    train, test = _loaders()
+    s = Solver(str(tmp_path / "solver.prototxt"), train, test,
+               strategy=SingleDevice(), out=str(tmp_path / "o"))
+    assert s.test_net is not s.net
+    s.solve()
+    res = s.test()
+    assert res["test_accuracy"] > 0.5, res
+
+
+def test_net_pooling_ceil_and_pad():
+    text = '''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "pool" type: "Pooling" bottom: "data" top: "pool"
+              pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+    '''
+    net = build_net(text)
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    out = net.apply(variables, jnp.ones((1, 32, 32, 3)))
+    assert out.shape == (1, 16, 16, 3)  # caffe ceil mode, not floor's 15
+    # -inf fill never leaks into the output
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+# ---- solver end-to-end ------------------------------------------------------
+
+TINY_NET = '''
+  name: "tiny"
+  layer { name: "d" type: "Data" top: "data" top: "label" }
+  layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+          inner_product_param { num_output: 32 } }
+  layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+  layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+          inner_product_param { num_output: 10 } }
+  layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" }
+'''
+
+
+def _solver_files(tmp_path, max_iter=30, extra=""):
+    net = tmp_path / "net.prototxt"
+    net.write_text(TINY_NET)
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'''
+      net: "net.prototxt"
+      base_lr: 0.1
+      momentum: 0.9
+      lr_policy: "fixed"
+      max_iter: {max_iter}
+      display: 10
+      random_seed: 3
+      snapshot_prefix: "{tmp_path}/tiny"
+      {extra}
+    ''')
+    return str(solver)
+
+
+def _loaders(batch=64, n=512):
+    x, y = class_pattern_images(n + 128, (64,), 10, seed=0, noise=0.1)
+    train = DataLoader({"image": x[:n], "label": y[:n]}, batch, seed=0)
+    test = DataLoader({"image": x[n:], "label": y[n:]}, batch, seed=0,
+                      drop_last=False)
+    return train, test
+
+
+def test_solver_converges_and_tests(tmp_path, devices):
+    train, test = _loaders()
+    s = Solver(_solver_files(tmp_path, max_iter=40,
+                             extra="test_iter: 2 test_interval: 20"),
+               train, test, strategy=SingleDevice(), out=str(tmp_path / "o"))
+    final = s.solve()
+    res = s.test()
+    assert s.iteration == 40
+    assert res["test_accuracy"] > 0.5, res
+    assert final.get("loss", final.get("test_loss")) < 2.3
+
+
+def test_solver_data_parallel(tmp_path, devices):
+    train, test = _loaders(batch=64)
+    s = Solver(_solver_files(tmp_path, max_iter=20), train, test,
+               strategy=DataParallel(), out=str(tmp_path / "o"))
+    s.solve()
+    assert s.iteration == 20
+    # replicated params stay identical across the 8 virtual devices
+    leaf = jax.tree.leaves(s.state.params)[0]
+    shards = [np.asarray(sh.data) for sh in leaf.addressable_shards]
+    for sh in shards[1:]:
+        np.testing.assert_array_equal(shards[0], sh)
+
+
+def test_solver_snapshot_resume(tmp_path, devices):
+    train, test = _loaders()
+    out = str(tmp_path / "o")
+    s1 = Solver(_solver_files(tmp_path, max_iter=10, extra="snapshot: 5"),
+                train, test, strategy=SingleDevice(), out=out)
+    s1.solve()
+    # fresh solver resumes from the final snapshot at iter 10
+    s2 = Solver(_solver_files(tmp_path, max_iter=10, extra="snapshot: 5"),
+                train, test, strategy=SingleDevice(), out=out)
+    assert s2.restore()
+    assert s2.iteration == 10
+    a = jax.tree.leaves(s1.state.params)[0]
+    b = jax.tree.leaves(s2.state.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_solver_iter_size_accumulation(tmp_path, devices):
+    train, test = _loaders()
+    s = Solver(_solver_files(tmp_path, max_iter=8, extra="iter_size: 2"),
+               train, test, strategy=SingleDevice(), out=str(tmp_path / "o"))
+    s.solve()
+    # caffe semantics: max_iter counts UPDATES; 8 updates = 16 batches here
+    assert s.iteration == 8
+    assert int(jax.device_get(s.state.step)) == 16
+    assert np.isfinite(float(jax.tree.leaves(s.state.params)[0].sum()))
+
+
+def test_solver_resume_at_max_iter_is_noop(tmp_path, devices):
+    train, test = _loaders()
+    out = str(tmp_path / "o")
+    s1 = Solver(_solver_files(tmp_path, max_iter=6, extra="snapshot: 6"),
+                train, test, strategy=SingleDevice(), out=out)
+    s1.solve()
+    s2 = Solver(_solver_files(tmp_path, max_iter=6, extra="snapshot: 6"),
+                train, test, strategy=SingleDevice(), out=out)
+    assert s2.restore()
+    assert s2.iteration == 6
+    assert s2.solve() == {}  # nothing left to do; no crash

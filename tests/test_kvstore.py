@@ -115,3 +115,18 @@ def test_host_init_roundtrip():
     kv.init("w", {"a": jnp.ones((2,))})
     out = kv.pull_init("w")
     np.testing.assert_allclose(np.asarray(out["a"]), np.ones((2,)))
+
+
+def test_width1_store_applies_rescale_and_average(devices):
+    """A 1-device store must produce the same numerics as an N-device one:
+    rescale/average apply even when no psum is needed."""
+    from jax.sharding import Mesh
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("data",))
+    kv = KVStore("local", mesh=mesh1, rescale=1.0 / 64)
+    x = jnp.full((4,), 64.0)
+    out = jax.jit(lambda v: kv.push_pull("g", v))(x)
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    # average=True with width 1 is a no-op divide by 1
+    kv2 = KVStore("local", mesh=mesh1)
+    out2 = jax.jit(lambda v: kv2.push_pull("g", v, average=True))(x)
+    np.testing.assert_allclose(np.asarray(out2), 64.0)
