@@ -122,6 +122,11 @@ class Checkpointer:
         with ocp.StandardCheckpointer() as ckptr:
             return ckptr.restore(path, like), step
 
+    def latest_step(self) -> int | None:
+        """Step of the newest full-state snapshot (None when none exist)."""
+        steps = self._list(self._SNAP_RE)
+        return max(steps) if steps else None
+
     def restore_path(self, like, path: str):
         """Restore from an explicit snapshot path (--resume <path>)."""
         import orbax.checkpoint as ocp

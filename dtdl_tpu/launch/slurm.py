@@ -1,0 +1,177 @@
+"""SLURM launcher integration.
+
+The reference *advertises* a SLURM-based launch variant for its DDP track
+(reference README.md:11) but ships no SLURM script anywhere in the tree
+(SURVEY §0) — launch is manual, one shell per rank (reference
+pytorch/README.md:69-113).  This module implements what that README promised,
+TPU-style: inside a SLURM allocation, every task derives its
+coordinator/num_processes/process_id for ``jax.distributed.initialize``
+directly from the environment SLURM already provides — no wrapper flags, no
+TF_CONFIG synthesis, no rank arithmetic in user scripts.
+
+Three surfaces:
+
+* ``from_env(environ)`` — (coordinator, num_processes, process_id) from
+  SLURM_PROCID / SLURM_NTASKS / SLURM_JOB_NODELIST (first node hosts the
+  coordinator; the port is derived stably from SLURM_JOB_ID so concurrent
+  jobs on a shared node don't collide).
+* ``expand_nodelist`` — SLURM's compressed hostlist syntax
+  (``tpu[001-003,007],login1``) → explicit host list.
+* ``sbatch_script`` / the CLI — generate a ready-to-submit batch script, or
+  (inside an allocation) exec the training script with the derived topology
+  appended:  ``srun python -m dtdl_tpu.launch.slurm -- train.py --flags``.
+
+`examples/common.bootstrap` consults `maybe_slurm()` automatically, so every
+example script becomes SLURM-launchable with zero changes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shlex
+import sys
+
+_BASE_PORT = 12800
+_PORT_SPAN = 4096
+
+
+def expand_nodelist(spec: str) -> list[str]:
+    """Expand SLURM's compressed nodelist: ``a[1-3,05,9],b2`` -> hosts.
+
+    Numeric ranges preserve zero-padding (``n[001-003]`` -> n001..n003).
+    """
+    hosts: list[str] = []
+    # split on commas that are not inside brackets
+    parts, depth, cur = [], 0, ""
+    for ch in spec:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        parts.append(cur)
+    for part in parts:
+        m = re.fullmatch(r"([^\[\]]+)\[([^\]]+)\](.*)", part.strip())
+        if not m:
+            if part.strip():
+                hosts.append(part.strip())
+            continue
+        prefix, body, suffix = m.groups()
+        for item in body.split(","):
+            if "-" in item:
+                lo, hi = item.split("-", 1)
+                width = len(lo) if lo.startswith("0") else 0
+                for n in range(int(lo), int(hi) + 1):
+                    hosts.append(f"{prefix}{n:0{width}d}{suffix}")
+            else:
+                hosts.append(f"{prefix}{item}{suffix}")
+    return hosts
+
+
+def job_port(environ=None) -> int:
+    """Stable per-job coordinator port (concurrent jobs don't collide)."""
+    environ = environ if environ is not None else os.environ
+    job = environ.get("SLURM_JOB_ID", "0")
+    return _BASE_PORT + (int(re.sub(r"\D", "", job) or 0) % _PORT_SPAN)
+
+
+def from_env(environ=None) -> tuple[str, int, int]:
+    """(coordinator, num_processes, process_id) from the SLURM environment.
+
+    Raises KeyError outside an allocation — callers use `maybe_slurm()` for
+    the optional form.
+    """
+    environ = environ if environ is not None else os.environ
+    ntasks = int(environ["SLURM_NTASKS"])
+    procid = int(environ["SLURM_PROCID"])
+    nodelist = (environ.get("SLURM_STEP_NODELIST")
+                or environ["SLURM_JOB_NODELIST"])
+    head = expand_nodelist(nodelist)[0]
+    return f"{head}:{job_port(environ)}", ntasks, procid
+
+
+def maybe_slurm(environ=None) -> dict | None:
+    """Topology kwargs for `runtime.initialize` when running under SLURM
+    with more than one task; None otherwise."""
+    environ = environ if environ is not None else os.environ
+    if "SLURM_PROCID" not in environ or "SLURM_NTASKS" not in environ:
+        return None
+    if int(environ["SLURM_NTASKS"]) <= 1:
+        return None
+    coordinator, num_processes, process_id = from_env(environ)
+    return {"coordinator": coordinator, "num_processes": num_processes,
+            "process_id": process_id}
+
+
+def sbatch_script(script_args: list[str], nodes: int = 2,
+                  ntasks_per_node: int = 1, job_name: str = "dtdl_tpu",
+                  time_limit: str = "01:00:00", partition: str = "") -> str:
+    """A ready-to-submit sbatch file: one task per host (the JAX
+    multi-controller model — each process drives all local TPU chips,
+    unlike the reference's one-process-per-GPU spawn)."""
+    payload = " ".join(shlex.quote(a) for a in script_args)
+    lines = [
+        "#!/bin/bash",
+        f"#SBATCH --job-name={job_name}",
+        f"#SBATCH --nodes={nodes}",
+        f"#SBATCH --ntasks-per-node={ntasks_per_node}",
+        f"#SBATCH --time={time_limit}",
+    ]
+    if partition:
+        lines.append(f"#SBATCH --partition={partition}")
+    lines += [
+        "",
+        "# every task self-discovers coordinator/rank from SLURM_* env",
+        f"srun python -m dtdl_tpu.launch.slurm -- {payload}",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """Inside an allocation: exec the script with derived topology flags.
+
+    ``--emit-sbatch [--nodes N ...]`` writes a batch script to stdout
+    instead (works anywhere, no SLURM needed).
+    """
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["--emit-sbatch"]:
+        argv = argv[1:]
+        nodes, per_node, partition = 2, 1, ""
+        while argv and argv[0] != "--":
+            if argv[0] == "--nodes":
+                nodes = int(argv[1]); argv = argv[2:]
+            elif argv[0] == "--ntasks-per-node":
+                per_node = int(argv[1]); argv = argv[2:]
+            elif argv[0] == "--partition":
+                partition = argv[1]; argv = argv[2:]
+            else:
+                raise SystemExit(f"unknown flag {argv[0]}")
+        script = argv[1:] if argv[:1] == ["--"] else argv
+        if not script:
+            raise SystemExit("no script given after --")
+        print(sbatch_script(script, nodes=nodes, ntasks_per_node=per_node,
+                            partition=partition))
+        return 0
+
+    script = argv[1:] if argv[:1] == ["--"] else argv
+    if not script:
+        raise SystemExit(
+            "usage: srun python -m dtdl_tpu.launch.slurm -- script.py --flags\n"
+            "   or: python -m dtdl_tpu.launch.slurm --emit-sbatch -- script.py")
+    coordinator, num_processes, process_id = from_env()
+    cmd = [sys.executable, *script,
+           "--coordinator", coordinator,
+           "--num-processes", str(num_processes),
+           "--process-id", str(process_id)]
+    os.execv(sys.executable, cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,3 +11,7 @@ from dtdl_tpu.train.fit import (  # noqa: F401
     Model, Callback, History, ModelCheckpoint, TensorBoard, PrintLR,
 )
 from dtdl_tpu.train.solver import Solver  # noqa: F401
+from dtdl_tpu.train.estimator import (  # noqa: F401
+    Estimator, EstimatorSpec, EvalSpec, ModeKeys, RunConfig, TrainSpec,
+    train_and_evaluate,
+)

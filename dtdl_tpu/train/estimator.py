@@ -1,0 +1,274 @@
+"""Estimator — the TF1-idiom API flavor (model_fn / input_fn / RunConfig).
+
+The reference declares a ``tensorflow/`` (TF1) track that was never written
+(reference tensorflow/README.md is zero-byte; declared at README.md:4-20).
+TF1's canonical training surface is the Estimator: a ``model_fn`` builds the
+graph per mode, an ``input_fn`` supplies data, ``RunConfig`` schedules
+checkpoints, and ``train_and_evaluate`` alternates the two — with the key
+behavioral contract that **every call restores the latest checkpoint from
+model_dir**, so training is resumable by construction and train/evaluate can
+run in separate processes.
+
+TPU-native restatement: the "graph per mode" becomes a flax module + optax
+transform returned once by ``model_fn(mode, params)``; each mode's step is a
+single jitted SPMD program over the strategy's mesh (TRAIN fuses forward/
+backward/allreduce/update like the engine's other flavors); the checkpoint
+contract is kept exactly — Estimator never holds training state across calls,
+it round-trips through model_dir.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtdl_tpu.ckpt.checkpoint import Checkpointer
+from dtdl_tpu.data.loader import DataLoader, prefetch_to_device
+from dtdl_tpu.metrics.report import Reporter, StdoutSink
+from dtdl_tpu.parallel.strategy import SingleDevice, Strategy
+from dtdl_tpu.train.loop import evaluate as _evaluate
+from dtdl_tpu.train.state import init_state
+from dtdl_tpu.train.step import (make_eval_step, make_predict_step,
+                                 make_train_step)
+
+
+class ModeKeys:
+    """tf.estimator.ModeKeys equivalents."""
+    TRAIN = "train"
+    EVAL = "eval"
+    PREDICT = "infer"
+
+
+@dataclass
+class EstimatorSpec:
+    """What ``model_fn`` returns for a mode.
+
+    ``model`` is a flax module (the per-mode "graph"); ``tx`` the optax
+    transform (TRAIN mode only); ``loss_fn`` overrides the default softmax
+    cross-entropy.  TF1's ops/hooks collapse into these three fields because
+    the step engine owns the rest of the program.
+    """
+    mode: str
+    model: Any
+    tx: Any = None
+    loss_fn: Any = None
+
+
+@dataclass
+class RunConfig:
+    """Checkpoint/logging cadence (tf.estimator.RunConfig surface)."""
+    save_checkpoints_steps: int = 1000
+    keep_checkpoint_max: int = 5
+    log_step_count_steps: int = 100
+    tf_random_seed: int = 0
+
+
+@dataclass
+class TrainSpec:
+    input_fn: Callable
+    max_steps: int
+
+
+@dataclass
+class EvalSpec:
+    input_fn: Callable
+    steps: int | None = None
+
+
+def _as_loader(data, batch_size: int = 128) -> DataLoader:
+    """input_fn may return a DataLoader or an (features, labels) pair."""
+    if isinstance(data, DataLoader) or hasattr(data, "batch_size"):
+        return data
+    features, labels = data
+    return DataLoader({"image": np.asarray(features),
+                       "label": np.asarray(labels)}, batch_size)
+
+
+class Estimator:
+    """tf.estimator.Estimator over the jitted step engine.
+
+    ``model_fn(mode, params) -> EstimatorSpec`` (``params`` is the
+    hyperparameter dict, TF1 style).  All state lives in ``model_dir``:
+    train() restores the latest checkpoint, advances, checkpoints;
+    evaluate()/predict() restore and run.  ``strategy`` injects DP/DDP the
+    way TF1 injected distribution via RunConfig train_distribute.
+    """
+
+    def __init__(self, model_fn: Callable, model_dir: str = "./estimator",
+                 config: RunConfig | None = None, params: dict | None = None,
+                 strategy: Strategy | None = None):
+        self.model_fn = model_fn
+        self.model_dir = model_dir
+        self.config = config or RunConfig()
+        self.params = params or {}
+        self.strategy = strategy or SingleDevice()
+        self.ckpt = Checkpointer(model_dir,
+                                 keep=self.config.keep_checkpoint_max)
+        self.reporter = Reporter([StdoutSink()])
+        # compiled steps are mode+strategy-determined: cache them so each
+        # train_and_evaluate leg reuses the XLA executable instead of
+        # recompiling (only the *state* round-trips through model_dir)
+        self._compiled: dict[str, Any] = {}
+
+    # -- state plumbing -------------------------------------------------------
+
+    def _build_state(self, spec: EstimatorSpec, example):
+        # the checkpoint always holds the TRAIN graph's variables (params +
+        # optimizer slots), TF1-style — so the restore template uses the
+        # TRAIN-mode optimizer even when evaluating/predicting
+        tx = spec.tx
+        if tx is None:
+            tx = self.model_fn(ModeKeys.TRAIN, self.params).tx
+        if tx is None:
+            import optax
+            tx = optax.sgd(0.01)
+        key = jax.random.PRNGKey(self.config.tf_random_seed)
+        return self.strategy.replicate(init_state(
+            spec.model, key, jnp.zeros((1,) + example.shape[1:]), tx))
+
+    def _restore_or_init(self, spec: EstimatorSpec, example):
+        state = self._build_state(spec, example)
+        restored, step = self.ckpt.restore(state)
+        if restored is not None:
+            return restored, int(step)
+        return state, 0
+
+    def latest_global_step(self) -> int:
+        """Step of the latest checkpoint in model_dir (0 if none)."""
+        return self.ckpt.latest_step() or 0
+
+    # -- the three verbs ------------------------------------------------------
+
+    def train(self, input_fn: Callable, steps: int | None = None,
+              max_steps: int | None = None) -> "Estimator":
+        """Advance training; restores latest checkpoint first (TF1 contract).
+
+        ``steps`` = additional steps from wherever the checkpoint left off;
+        ``max_steps`` = absolute global-step ceiling (no-op if reached).
+        """
+        spec = self.model_fn(ModeKeys.TRAIN, self.params)
+        loader = _as_loader(input_fn())
+        sample = next(iter(loader))
+        state, global_step = self._restore_or_init(spec, sample["image"])
+        target = (max_steps if max_steps is not None
+                  else global_step + (steps if steps is not None else 1000))
+        if global_step >= target:
+            return self
+
+        if "train" not in self._compiled:
+            self._compiled["train"] = make_train_step(
+                self.strategy, **({"loss_fn": spec.loss_fn} if spec.loss_fn
+                                  else {}),
+                seed=self.config.tf_random_seed)
+        train_step = self._compiled["train"]
+        cfg = self.config
+        t0, logged_at = time.time(), global_step
+        # the shuffle order is deterministic in (seed, epoch): resume at the
+        # epoch/offset the restored global_step corresponds to, so successive
+        # train_and_evaluate legs walk the dataset instead of retraining on
+        # the same leading batches each leg
+        steps_per_epoch = len(loader)
+        epoch = global_step // steps_per_epoch
+        skip = global_step % steps_per_epoch
+        last_saved = global_step
+        while global_step < target:
+            loader.set_epoch(epoch)
+            raw = iter(loader)
+            if skip:
+                offset = skip  # the lazy generator must not see skip's reset
+                raw = (b for j, b in enumerate(raw) if j >= offset)
+                skip = 0
+            it = prefetch_to_device(raw, self.strategy.shard_batch, 2)
+            for batch in it:
+                if global_step >= target:
+                    break
+                state, metrics = train_step(state, batch)
+                global_step += 1
+                if (cfg.log_step_count_steps
+                        and global_step % cfg.log_step_count_steps == 0):
+                    dt = time.time() - t0
+                    rate = (global_step - logged_at) / max(dt, 1e-9)
+                    t0, logged_at = time.time(), global_step
+                    self.reporter.report({
+                        "global_step": global_step,
+                        "loss": float(metrics["loss"]),
+                        "global_step/sec": round(rate, 2),
+                    })
+                if (cfg.save_checkpoints_steps
+                        and global_step % cfg.save_checkpoints_steps == 0):
+                    self.ckpt.save(global_step, state)
+                    last_saved = global_step
+            epoch += 1
+        if global_step != last_saved:
+            self.ckpt.save(global_step, state)
+        return self
+
+    def evaluate(self, input_fn: Callable, steps: int | None = None) -> dict:
+        """Exact metrics at the latest checkpoint (padded ragged tails)."""
+        spec = self.model_fn(ModeKeys.EVAL, self.params)
+        loader = _as_loader(input_fn())
+        sample = next(iter(loader))
+        state, global_step = self._restore_or_init(spec, sample["image"])
+        if steps:
+            from dtdl_tpu.train.solver import _LimitBatches
+            loader = _LimitBatches(loader, steps)
+        if "eval" not in self._compiled:
+            self._compiled["eval"] = make_eval_step(
+                self.strategy, **({"loss_fn": spec.loss_fn} if spec.loss_fn
+                                  else {}))
+        means = _evaluate(self._compiled["eval"], state, loader,
+                          self.strategy)
+        result = {**means, "global_step": global_step}
+        self.reporter.report({"split": "eval", **result})
+        return result
+
+    def predict(self, input_fn: Callable):
+        """Generator of per-example prediction dicts (TF1 predict shape).
+
+        Ragged tail batches are padded to the loader's batch size (mesh
+        strategies shard the batch dim) and the padding rows dropped from
+        the yielded stream.
+        """
+        from dtdl_tpu.train.loop import _pad_and_mask
+        spec = self.model_fn(ModeKeys.PREDICT, self.params)
+        loader = _as_loader(input_fn())
+        sample = next(iter(loader))
+        state, _ = self._restore_or_init(spec, sample["image"])
+        if "predict" not in self._compiled:
+            self._compiled["predict"] = make_predict_step(self.strategy)
+        predict_step = self._compiled["predict"]
+        for batch in iter(loader):
+            n = len(next(iter(batch.values())))
+            padded = _pad_and_mask(batch, loader.batch_size)
+            padded.pop("mask")
+            logits = np.asarray(jax.device_get(predict_step(
+                state, self.strategy.shard_batch(padded))))[:n]
+            for row in logits:
+                yield {"logits": row, "class_ids": int(np.argmax(row)),
+                       "probabilities": _softmax(row)}
+
+
+def _softmax(row: np.ndarray) -> np.ndarray:
+    e = np.exp(row - row.max())
+    return e / e.sum()
+
+
+def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
+                       eval_spec: EvalSpec) -> dict:
+    """tf.estimator.train_and_evaluate: train in checkpoint-sized legs,
+    evaluating after each new checkpoint, until max_steps."""
+    leg = max(1, estimator.config.save_checkpoints_steps)
+    result: dict = {}
+    while True:
+        at = estimator.latest_global_step()
+        if at >= train_spec.max_steps:
+            break
+        estimator.train(train_spec.input_fn,
+                        max_steps=min(at + leg, train_spec.max_steps))
+        result = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
+    return result

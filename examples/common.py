@@ -38,9 +38,15 @@ def bootstrap(args):
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu" and getattr(args, "fake_devices", 0):
             jax.config.update("jax_num_cpu_devices", args.fake_devices)
-    initialize(coordinator=getattr(args, "coordinator", ""),
-               num_processes=getattr(args, "num_processes", 1),
-               process_id=getattr(args, "process_id", 0))
+    topo = {"coordinator": getattr(args, "coordinator", ""),
+            "num_processes": getattr(args, "num_processes", 1),
+            "process_id": getattr(args, "process_id", 0)}
+    if not topo["coordinator"]:
+        # inside a multi-task SLURM allocation every script is launchable
+        # with zero flags (the reference only advertised this; README.md:11)
+        from dtdl_tpu.launch.slurm import maybe_slurm
+        topo = maybe_slurm() or topo
+    initialize(**topo)
     if is_leader():
         print(banner(), flush=True)
 

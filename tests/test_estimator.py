@@ -1,0 +1,156 @@
+"""Estimator (TF1-idiom) tests: model_fn/input_fn/RunConfig contract,
+checkpoint-roundtrip-per-call semantics, train_and_evaluate alternation.
+(Reference tensorflow/README.md is an empty placeholder; SURVEY §2.1.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dtdl_tpu.data import DataLoader
+from dtdl_tpu.data.synthetic import class_pattern_images
+from dtdl_tpu.models import MLP
+from dtdl_tpu.parallel import DataParallel, SingleDevice
+from dtdl_tpu.train import (Estimator, EstimatorSpec, EvalSpec, ModeKeys,
+                            RunConfig, TrainSpec, train_and_evaluate)
+
+
+def model_fn(mode, params):
+    model = MLP(n_units=params.get("units", 32))
+    tx = optax.sgd(params.get("lr", 0.1), momentum=0.9) \
+        if mode == ModeKeys.TRAIN else None
+    return EstimatorSpec(mode=mode, model=model, tx=tx)
+
+
+def data(n=512):
+    x, y = class_pattern_images(n + 128, (784,), 10, seed=0, noise=0.1)
+    return (x[:n], y[:n]), (x[n:], y[n:])
+
+
+def loaders(batch=64):
+    (x, y), (vx, vy) = data()
+    return (lambda: DataLoader({"image": x, "label": y}, batch, seed=0),
+            lambda: DataLoader({"image": vx, "label": vy}, batch, seed=0,
+                               shuffle=False, drop_last=False))
+
+
+def test_train_checkpoints_and_resumes(tmp_path, devices):
+    train_fn, eval_fn = loaders()
+    est = Estimator(model_fn, str(tmp_path), RunConfig(
+        save_checkpoints_steps=10, log_step_count_steps=0))
+    est.train(train_fn, steps=20)
+    assert est.latest_global_step() == 20
+    # a NEW estimator on the same model_dir continues from step 20
+    est2 = Estimator(model_fn, str(tmp_path), RunConfig(
+        save_checkpoints_steps=10, log_step_count_steps=0))
+    est2.train(train_fn, steps=10)
+    assert est2.latest_global_step() == 30
+    # max_steps below current global step is a no-op
+    est2.train(train_fn, max_steps=5)
+    assert est2.latest_global_step() == 30
+
+
+def test_evaluate_reads_latest_checkpoint(tmp_path, devices):
+    train_fn, eval_fn = loaders()
+    est = Estimator(model_fn, str(tmp_path),
+                    RunConfig(log_step_count_steps=0))
+    r0 = est.evaluate(eval_fn)  # no checkpoint yet: fresh init
+    assert r0["global_step"] == 0
+    est.train(train_fn, steps=60)
+    r1 = est.evaluate(eval_fn)
+    assert r1["global_step"] == 60
+    assert r1["accuracy"] > r0["accuracy"]
+    assert r1["accuracy"] > 0.8, r1
+
+
+def test_train_and_evaluate_alternates(tmp_path, devices):
+    train_fn, eval_fn = loaders()
+    est = Estimator(model_fn, str(tmp_path), RunConfig(
+        save_checkpoints_steps=20, log_step_count_steps=0))
+    result = train_and_evaluate(est, TrainSpec(train_fn, max_steps=50),
+                                EvalSpec(eval_fn, steps=2))
+    assert est.latest_global_step() == 50
+    assert result["global_step"] == 50
+    assert np.isfinite(result["loss"])
+
+
+def test_predict_generator(tmp_path, devices):
+    train_fn, eval_fn = loaders()
+    est = Estimator(model_fn, str(tmp_path),
+                    RunConfig(log_step_count_steps=0))
+    est.train(train_fn, steps=40)
+    import itertools
+    preds = list(itertools.islice(est.predict(eval_fn), 8))
+    assert len(preds) == 8
+    for p in preds:
+        assert p["logits"].shape == (10,)
+        assert 0 <= p["class_ids"] < 10
+        np.testing.assert_allclose(p["probabilities"].sum(), 1.0, rtol=1e-5)
+    # trained predictions should mostly match labels on this easy data
+    (_, _), (vx, vy) = data()
+    hits = sum(int(p["class_ids"] == int(vy[i])) for i, p in enumerate(preds))
+    assert hits >= 6
+
+
+def test_estimator_data_parallel(tmp_path, devices):
+    train_fn, eval_fn = loaders(batch=64)
+    est = Estimator(model_fn, str(tmp_path),
+                    RunConfig(log_step_count_steps=0),
+                    strategy=DataParallel())
+    est.train(train_fn, steps=20)
+    r = est.evaluate(eval_fn)
+    assert r["global_step"] == 20
+    assert np.isfinite(r["loss"])
+
+
+class SpyLoader(DataLoader):
+    """Records the epochs the train loop walks via set_epoch."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.epochs = []
+
+    def set_epoch(self, epoch):
+        self.epochs.append(epoch)
+        super().set_epoch(epoch)
+
+
+def test_train_legs_walk_the_dataset(tmp_path, devices):
+    """Successive train() calls resume at the epoch/offset of the restored
+    global step — a second leg must advance into epoch 1 instead of
+    retraining epoch 0's leading batches forever."""
+    (x, y), _ = data(256)  # 4 batches/epoch at batch 64
+    loader = SpyLoader({"image": x, "label": y}, 64, seed=0)
+    est = Estimator(model_fn, str(tmp_path), RunConfig(
+        save_checkpoints_steps=100, log_step_count_steps=0))
+    est.train(lambda: loader, steps=2)   # trains batches 0-1 of epoch 0
+    est.train(lambda: loader, steps=3)   # 2-3 of epoch 0, then 0 of epoch 1
+    # leg 1: set_epoch(0); leg 2: resumes within epoch 0, then enters epoch 1
+    assert loader.epochs == [0, 0, 1]
+    assert est.latest_global_step() == 5
+
+
+def test_predict_ragged_tail_under_ddp(tmp_path, devices):
+    """Tail batch smaller than batch_size is padded for the 8-way mesh and
+    the padding rows are dropped from the yielded predictions."""
+    (x, y), _ = data(n=100)
+    train_fn = lambda: DataLoader({"image": x[:96], "label": y[:96]}, 48,
+                                  seed=0)
+    pred_fn = lambda: DataLoader({"image": x[:100], "label": y[:100]}, 48,
+                                 shuffle=False, drop_last=False, seed=0)
+    est = Estimator(model_fn, str(tmp_path),
+                    RunConfig(log_step_count_steps=0),
+                    strategy=DataParallel())
+    est.train(train_fn, steps=2)
+    preds = list(est.predict(pred_fn))
+    assert len(preds) == 100  # 48 + 48 + ragged 4, padding dropped
+
+
+def test_input_fn_array_pair(tmp_path, devices):
+    """input_fn may return a raw (features, labels) pair, TF1-style."""
+    (x, y), _ = data()
+    est = Estimator(model_fn, str(tmp_path),
+                    RunConfig(log_step_count_steps=0))
+    est.train(lambda: (x, y), steps=5)
+    assert est.latest_global_step() == 5

@@ -1,0 +1,74 @@
+"""SLURM launcher integration (the launch variant the reference advertises
+at README.md:11 but never shipped — SURVEY §0)."""
+
+import pytest
+
+from dtdl_tpu.launch import slurm
+
+
+@pytest.mark.parametrize("spec,expect", [
+    ("c1", ["c1"]),
+    ("c1,c2", ["c1", "c2"]),
+    ("tpu[1-3]", ["tpu1", "tpu2", "tpu3"]),
+    ("n[001-003]", ["n001", "n002", "n003"]),
+    ("a[1-2,5],b7", ["a1", "a2", "a5", "b7"]),
+    ("gpu[09-11]", ["gpu09", "gpu10", "gpu11"]),
+    ("r[1-2]n[3]", ["r1n[3]", "r2n[3]"]),  # only first bracket expands
+    ("", []),
+])
+def test_expand_nodelist(spec, expect):
+    assert slurm.expand_nodelist(spec) == expect
+
+
+def fake_env(procid=1, ntasks=4, nodelist="tpu[1-2]", job="98765"):
+    return {"SLURM_PROCID": str(procid), "SLURM_NTASKS": str(ntasks),
+            "SLURM_JOB_NODELIST": nodelist, "SLURM_JOB_ID": job}
+
+
+def test_from_env_derives_topology():
+    coordinator, n, i = slurm.from_env(fake_env())
+    host, port = coordinator.rsplit(":", 1)
+    assert host == "tpu1"  # first node hosts the coordinator
+    assert n == 4 and i == 1
+    assert 12800 <= int(port) < 12800 + 4096
+
+
+def test_port_stable_per_job_distinct_across_jobs():
+    a = slurm.job_port(fake_env(job="111"))
+    b = slurm.job_port(fake_env(job="111"))
+    c = slurm.job_port(fake_env(job="112"))
+    assert a == b != c
+
+
+def test_step_nodelist_preferred():
+    env = {**fake_env(), "SLURM_STEP_NODELIST": "tpu2"}
+    coordinator, _, _ = slurm.from_env(env)
+    assert coordinator.startswith("tpu2:")
+
+
+def test_maybe_slurm():
+    assert slurm.maybe_slurm({}) is None
+    assert slurm.maybe_slurm(fake_env(ntasks=1)) is None  # single task: local
+    topo = slurm.maybe_slurm(fake_env(procid=3))
+    assert topo == {"coordinator": topo["coordinator"],
+                    "num_processes": 4, "process_id": 3}
+
+
+def test_sbatch_script_shape():
+    text = slurm.sbatch_script(["examples/distributed_data_parallel.py",
+                                "--batch-size", "256"],
+                               nodes=4, partition="tpu")
+    assert text.startswith("#!/bin/bash")
+    assert "#SBATCH --nodes=4" in text
+    assert "#SBATCH --partition=tpu" in text
+    assert "srun python -m dtdl_tpu.launch.slurm -- " \
+           "examples/distributed_data_parallel.py --batch-size 256" in text
+
+
+def test_emit_sbatch_cli(capsys):
+    rc = slurm.main(["--emit-sbatch", "--nodes", "3", "--",
+                     "train.py", "--lr", "0.1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "#SBATCH --nodes=3" in out
+    assert "train.py --lr 0.1" in out
