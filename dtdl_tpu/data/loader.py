@@ -57,16 +57,43 @@ class DataLoader:
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def __iter__(self):
+        yield from self.iter_from(0)
+
+    def iter_from(self, start_batch: int):
+        """Iterate beginning at batch ``start_batch`` of this epoch.
+
+        The skip happens at the index level — O(1), no skipped batch is
+        materialized — which is what makes mid-epoch resume cheap
+        (Trainer/Estimator restore at ``global_step % steps_per_epoch``).
+        """
         idx = np.asarray(self.sampler.indices())
         rng = np.random.default_rng((self.sampler.seed, self._epoch, 7))
         n_full = len(idx) // self.batch_size
         stop = n_full * self.batch_size if self.drop_last else len(idx)
-        for start in range(0, stop, self.batch_size):
+        for start in range(start_batch * self.batch_size, stop,
+                           self.batch_size):
             take = idx[start:start + self.batch_size]
             batch = {k: v[take] for k, v in self.arrays.items()}
             if self.transform is not None:
                 batch = self.transform(rng, batch)
             yield batch
+
+
+class LimitBatches:
+    """First-n-batches view of a loader (e.g. Caffe's test_iter, TF1's
+    evaluate(steps=N)).  ``n=0`` means no limit."""
+
+    def __init__(self, loader, n: int):
+        self.loader, self.n = loader, n
+
+    @property
+    def batch_size(self):
+        return self.loader.batch_size
+
+    def __iter__(self):
+        import itertools
+        it = iter(self.loader)
+        return itertools.islice(it, self.n) if self.n else it
 
 
 def prefetch_to_device(iterator, put: Callable, depth: int = 2):

@@ -84,11 +84,15 @@ def job_port(environ=None) -> int:
 def from_env(environ=None) -> tuple[str, int, int]:
     """(coordinator, num_processes, process_id) from the SLURM environment.
 
+    Task count comes from the *step* when present (srun sets
+    SLURM_STEP_NUM_TASKS; the sbatch batch step runs as a 1-task step even
+    when the job requests more), falling back to the job's SLURM_NTASKS.
     Raises KeyError outside an allocation — callers use `maybe_slurm()` for
     the optional form.
     """
     environ = environ if environ is not None else os.environ
-    ntasks = int(environ["SLURM_NTASKS"])
+    ntasks = int(environ.get("SLURM_STEP_NUM_TASKS")
+                 or environ["SLURM_NTASKS"])
     procid = int(environ["SLURM_PROCID"])
     nodelist = (environ.get("SLURM_STEP_NODELIST")
                 or environ["SLURM_JOB_NODELIST"])
@@ -97,12 +101,20 @@ def from_env(environ=None) -> tuple[str, int, int]:
 
 
 def maybe_slurm(environ=None) -> dict | None:
-    """Topology kwargs for `runtime.initialize` when running under SLURM
-    with more than one task; None otherwise."""
+    """Topology kwargs for `runtime.initialize` when running under a
+    multi-task SLURM *step*; None otherwise.
+
+    Counts tasks per the current step, not the job: a script run directly
+    in an sbatch batch script (no srun) is a 1-task step even when the job
+    requested --ntasks=4, and must stay single-process — initializing a
+    4-process world there would block forever waiting for peers.
+    """
     environ = environ if environ is not None else os.environ
     if "SLURM_PROCID" not in environ or "SLURM_NTASKS" not in environ:
         return None
-    if int(environ["SLURM_NTASKS"]) <= 1:
+    ntasks = int(environ.get("SLURM_STEP_NUM_TASKS")
+                 or environ["SLURM_NTASKS"])
+    if ntasks <= 1:
         return None
     coordinator, num_processes, process_id = from_env(environ)
     return {"coordinator": coordinator, "num_processes": num_processes,

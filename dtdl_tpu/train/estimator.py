@@ -50,8 +50,12 @@ class EstimatorSpec:
 
     ``model`` is a flax module (the per-mode "graph"); ``tx`` the optax
     transform (TRAIN mode only); ``loss_fn`` overrides the default softmax
-    cross-entropy.  TF1's ops/hooks collapse into these three fields because
-    the step engine owns the rest of the program.
+    cross-entropy and must follow the engine's loss contract:
+    ``loss_fn(logits, labels, reduction="mean"|"none")`` (the eval step
+    requests per-example losses via reduction="none" — see
+    dtdl_tpu.ops.softmax_cross_entropy for the reference implementation).
+    TF1's ops/hooks collapse into these three fields because the step
+    engine owns the rest of the program.
     """
     mode: str
     model: Any
@@ -149,14 +153,17 @@ class Estimator:
         """Advance training; restores latest checkpoint first (TF1 contract).
 
         ``steps`` = additional steps from wherever the checkpoint left off;
-        ``max_steps`` = absolute global-step ceiling (no-op if reached).
+        ``max_steps`` = absolute global-step ceiling (no-op if reached);
+        neither = one full pass over input_fn's data (TF1 trains until the
+        input is exhausted).
         """
         spec = self.model_fn(ModeKeys.TRAIN, self.params)
         loader = _as_loader(input_fn())
         sample = next(iter(loader))
         state, global_step = self._restore_or_init(spec, sample["image"])
         target = (max_steps if max_steps is not None
-                  else global_step + (steps if steps is not None else 1000))
+                  else global_step + (steps if steps is not None
+                                      else len(loader)))
         if global_step >= target:
             return self
 
@@ -178,11 +185,14 @@ class Estimator:
         last_saved = global_step
         while global_step < target:
             loader.set_epoch(epoch)
-            raw = iter(loader)
-            if skip:
+            if skip and hasattr(loader, "iter_from"):
+                raw = loader.iter_from(skip)  # index-level skip: O(1)
+            elif skip:
                 offset = skip  # the lazy generator must not see skip's reset
-                raw = (b for j, b in enumerate(raw) if j >= offset)
-                skip = 0
+                raw = (b for j, b in enumerate(iter(loader)) if j >= offset)
+            else:
+                raw = iter(loader)
+            skip = 0
             it = prefetch_to_device(raw, self.strategy.shard_batch, 2)
             for batch in it:
                 if global_step >= target:
@@ -215,8 +225,8 @@ class Estimator:
         sample = next(iter(loader))
         state, global_step = self._restore_or_init(spec, sample["image"])
         if steps:
-            from dtdl_tpu.train.solver import _LimitBatches
-            loader = _LimitBatches(loader, steps)
+            from dtdl_tpu.data.loader import LimitBatches
+            loader = LimitBatches(loader, steps)
         if "eval" not in self._compiled:
             self._compiled["eval"] = make_eval_step(
                 self.strategy, **({"loss_fn": spec.loss_fn} if spec.loss_fn

@@ -32,7 +32,7 @@ import numpy as np
 import optax
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer
-from dtdl_tpu.data.loader import prefetch_to_device
+from dtdl_tpu.data.loader import LimitBatches, prefetch_to_device
 from dtdl_tpu.metrics.report import Reporter, StdoutSink
 from dtdl_tpu.train.loop import evaluate as _evaluate
 from dtdl_tpu.models.netspec import build_net
@@ -113,21 +113,6 @@ def make_optimizer(sp: Message):
     return opt
 
 
-class _LimitBatches:
-    """First-n-batches view of a loader (Caffe's test_iter semantics)."""
-
-    def __init__(self, loader, n: int):
-        self.loader, self.n = loader, n
-
-    @property
-    def batch_size(self):
-        return self.loader.batch_size
-
-    def __iter__(self):
-        import itertools
-        return itertools.islice(iter(self.loader), self.n)
-
-
 class Solver:
     """``caffe train`` equivalent over the jitted step engine.
 
@@ -138,9 +123,13 @@ class Solver:
 
     def __init__(self, solver_path_or_msg, train_loader, test_loader=None,
                  strategy: Strategy | None = None, dtype=jnp.float32,
-                 out: str | None = None):
+                 out: str | None = None, overrides: dict | None = None):
         sp = (parse_file(solver_path_or_msg)
               if isinstance(solver_path_or_msg, str) else solver_path_or_msg)
+        # overrides must land BEFORE the optimizer is built: lr policies
+        # like poly/multistep close over max_iter/stepvalue at construction
+        if overrides:
+            sp = Message({**sp, **overrides})
         self.param = sp
         self.strategy = strategy or SingleDevice()
         self.train_loader = train_loader
@@ -194,7 +183,7 @@ class Solver:
         every real example counts exactly once.
         """
         test_iter = int(self.param.get_scalar("test_iter", 0))
-        loader = (_LimitBatches(self.test_loader, test_iter) if test_iter
+        loader = (LimitBatches(self.test_loader, test_iter) if test_iter
                   else self.test_loader)
         # evaluate through the test net (== train net unless test_net given)
         state = self.state.replace(apply_fn=self.test_net.apply)

@@ -123,17 +123,22 @@ class Trainer:
         while not self._done:
             self.train_loader.set_epoch(self.epoch)
             self.timer.reset_epoch()
-            raw = iter(self.train_loader)
             if self._skip_batches:
                 # mid-epoch resume: the sampler's (seed, epoch) order is
-                # deterministic, so skipping the consumed prefix replays the
-                # exact remainder of the interrupted epoch (Chainer resume
-                # parity — its snapshot serializes the iterator position,
-                # reference chainer/train_mnist.py:120-122).
+                # deterministic, so starting at the consumed prefix replays
+                # the exact remainder of the interrupted epoch (Chainer
+                # resume parity — its snapshot serializes the iterator
+                # position, reference chainer/train_mnist.py:120-122).
+                # iter_from skips at the index level (O(1)).
                 skip = self._skip_batches
                 self._skip_batches = 0
-                raw = (b for i, b in enumerate(raw) if i >= skip)
+                if hasattr(self.train_loader, "iter_from"):
+                    raw = self.train_loader.iter_from(skip)
+                else:
+                    raw = (b for i, b in enumerate(iter(self.train_loader))
+                           if i >= skip)
             else:
+                raw = iter(self.train_loader)
                 self.iteration_in_epoch = 0
             it = prefetch_to_device(raw, self.strategy.shard_batch,
                                     self.prefetch)

@@ -33,6 +33,8 @@ def main():
               "(caffe's -gpu flag; devices are mesh chips here)")
     flag(parser, "--out", default="",
          help="override snapshot/output directory")
+    flag(parser, "--max-iter", type=int, default=0,
+         help="override the solver's max_iter (0 = use prototxt value)")
     flag(parser, "-b", "--batch-size", "--batchsize", type=int, default=64,
          help="GLOBAL batch size (a data-layer concern in caffe)")
     add_data_flags(parser, dataset="mnist")
@@ -61,7 +63,9 @@ def main():
                                      seed=0, drop_last=False)
 
     solver = Solver(args.solver, train_loader, test_loader,
-                    strategy=strategy, out=args.out or None)
+                    strategy=strategy, out=args.out or None,
+                    overrides={"max_iter": args.max_iter} if args.max_iter
+                    else None)
     if args.snapshot:
         ok = solver.restore(None if args.snapshot == "latest"
                             else int(args.snapshot))

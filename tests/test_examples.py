@@ -142,3 +142,37 @@ def test_train_lm_4d_example(tmp_path):
     m = re.search(r"final loss ([\d.]+)", out)
     assert m, out
     assert float(m.group(1)) < 10.0
+
+
+def test_caffe_train_example(tmp_path):
+    out = run_example(
+        "caffe_train.py", "--solver", "caffe/lenet_solver.prototxt",
+        "--limit-train", "256", "--limit-test", "128", "-b", "32",
+        "--max-iter", "80", "--dataset-dir", str(tmp_path / "none"),
+        "--out", str(tmp_path / "snap"), timeout=600)
+    m = re.search(r"test_accuracy': ([\d.]+)", out)
+    assert m, out
+    assert float(m.group(1)) > 0.5
+
+
+def test_tf_estimator_example(tmp_path):
+    out = run_example(
+        "tf_estimator.py", "--train_steps", "40",
+        "--save_checkpoints_steps", "20", "--batch_size", "32",
+        "--limit-train", "256", "--limit-test", "128",
+        "--dataset-dir", str(tmp_path / "none"),
+        "--model_dir", str(tmp_path / "est"), timeout=600)
+    assert "final eval:" in out
+    m = re.search(r"'accuracy': ([\d.]+)", out)
+    assert m and float(m.group(1)) > 0.5, out
+
+
+def test_imagenet_resnet50_example(tmp_path):
+    out = run_example(
+        "imagenet_resnet50.py", "--steps", "6", "--batch-size", "8",
+        "--image-size", "32", "--num-classes", "8",
+        "--train-examples", "64", "--warmup-steps", "2",
+        "--log-interval", "3", "--dtype", "float32",
+        "--dataset-dir", str(tmp_path / "none"), timeout=600)
+    assert "samples/sec" in out
+    assert re.search(r"step 6/6", out), out

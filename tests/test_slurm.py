@@ -54,6 +54,18 @@ def test_maybe_slurm():
                     "num_processes": 4, "process_id": 3}
 
 
+def test_maybe_slurm_ignores_batch_step():
+    """A script run directly in the sbatch batch script (no srun) is a
+    1-task step even when the job requested 4 tasks — it must NOT
+    initialize a 4-process world (it would hang waiting for peers)."""
+    env = {**fake_env(procid=0, ntasks=4), "SLURM_STEP_NUM_TASKS": "1"}
+    assert slurm.maybe_slurm(env) is None
+    # under srun the step task count matches and topology is derived
+    env["SLURM_STEP_NUM_TASKS"] = "4"
+    topo = slurm.maybe_slurm(env)
+    assert topo is not None and topo["num_processes"] == 4
+
+
 def test_sbatch_script_shape():
     text = slurm.sbatch_script(["examples/distributed_data_parallel.py",
                                 "--batch-size", "256"],
