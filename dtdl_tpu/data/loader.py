@@ -65,18 +65,38 @@ class DataLoader:
         The skip happens at the index level — O(1), no skipped batch is
         materialized — which is what makes mid-epoch resume cheap
         (Trainer/Estimator restore at ``global_step % steps_per_epoch``).
+
+        The transform rng is keyed by (seed, epoch, batch index), NOT drawn
+        sequentially, so batch k gets bitwise-identical augmentations
+        whether the epoch ran straight through or resumed at k — the
+        replay-exact property mid-epoch resume relies on.
         """
         idx = np.asarray(self.sampler.indices())
-        rng = np.random.default_rng((self.sampler.seed, self._epoch, 7))
         n_full = len(idx) // self.batch_size
         stop = n_full * self.batch_size if self.drop_last else len(idx)
-        for start in range(start_batch * self.batch_size, stop,
-                           self.batch_size):
+        for b, start in enumerate(range(start_batch * self.batch_size, stop,
+                                        self.batch_size),
+                                  start=start_batch):
             take = idx[start:start + self.batch_size]
             batch = {k: v[take] for k, v in self.arrays.items()}
             if self.transform is not None:
+                rng = np.random.default_rng(
+                    (self.sampler.seed, self._epoch, b, 7))
                 batch = self.transform(rng, batch)
             yield batch
+
+
+def resume_iter(loader, skip: int):
+    """Iterator over ``loader`` starting at batch ``skip`` of the current
+    epoch — O(1) via ``iter_from`` when the loader supports it, else an
+    enumerate-filter fallback (still consumes the skipped batches).  The
+    single implementation of mid-epoch resume used by Trainer and
+    Estimator."""
+    if not skip:
+        return iter(loader)
+    if hasattr(loader, "iter_from"):
+        return loader.iter_from(skip)
+    return (b for j, b in enumerate(iter(loader)) if j >= skip)
 
 
 class LimitBatches:

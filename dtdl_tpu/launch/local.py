@@ -26,8 +26,16 @@ import time
 
 def launch_local(script_args: list[str], nproc: int = 2, port: int = 12355,
                  env_extra: dict | None = None, timeout: float = 600.0,
-                 devices_per_proc: int | None = None) -> int:
+                 devices_per_proc: int | None = None,
+                 max_restarts: int = 0) -> int:
     """Spawn ``nproc`` processes of a script; non-zero if any rank failed.
+
+    ``max_restarts`` adds elastic recovery beyond the reference (whose jobs
+    hang forever on a dead rank, SURVEY §5.3): after a failed attempt the
+    WHOLE world is relaunched — ranks resume from their latest checkpoint
+    (Trainer/Estimator/Solver all restore from their output directory), the
+    standard checkpoint-restart model for synchronous SPMD where a lost
+    participant invalidates the collective world.
 
     Each child receives ``--coordinator 127.0.0.1:port --num-processes nproc
     --process-id i`` appended to its argv (the script is expected to pass
@@ -38,6 +46,21 @@ def launch_local(script_args: list[str], nproc: int = 2, port: int = 12355,
     the dying rank's code is returned: fail fast instead of the reference's
     silent hang.
     """
+    attempt = 0
+    while True:
+        rc = _launch_once(script_args, nproc, port, env_extra, timeout,
+                          devices_per_proc)
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        print(f"[launcher] attempt {attempt}/{max_restarts}: relaunching "
+              f"all {nproc} ranks (resume from latest checkpoint)",
+              flush=True)
+
+
+def _launch_once(script_args: list[str], nproc: int, port: int,
+                 env_extra: dict | None, timeout: float,
+                 devices_per_proc: int | None) -> int:
     procs: list[subprocess.Popen] = []
     coordinator = f"127.0.0.1:{port}"
     for i in range(nproc):
@@ -102,7 +125,7 @@ def launch_local(script_args: list[str], nproc: int = 2, port: int = 12355,
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    nproc, port, devices = 2, 12355, None
+    nproc, port, devices, restarts = 2, 12355, None, 0
     while argv and argv[0] != "--":
         if argv[0] == "--nproc":
             nproc = int(argv[1]); argv = argv[2:]
@@ -110,6 +133,8 @@ def main(argv=None) -> int:
             port = int(argv[1]); argv = argv[2:]
         elif argv[0] == "--devices-per-proc":
             devices = int(argv[1]); argv = argv[2:]
+        elif argv[0] == "--max-restarts":
+            restarts = int(argv[1]); argv = argv[2:]
         else:
             raise SystemExit(f"unknown launcher flag {argv[0]} "
                              "(use: --nproc N --port P -- script.py ...)")
@@ -119,7 +144,7 @@ def main(argv=None) -> int:
         raise SystemExit("no script given; usage: "
                          "python -m dtdl_tpu.launch.local --nproc 2 -- script.py")
     return launch_local(argv, nproc=nproc, port=port,
-                        devices_per_proc=devices)
+                        devices_per_proc=devices, max_restarts=restarts)
 
 
 if __name__ == "__main__":

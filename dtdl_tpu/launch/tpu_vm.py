@@ -67,13 +67,35 @@ def build_commands(workers: list[str], script_args: list[str],
 
 
 def run(workers: list[str], cmds: list[list[str]],
-        poll_interval: float = 2.0) -> int:
+        poll_interval: float = 2.0, max_restarts: int = 0,
+        restart_delay: float = 10.0) -> int:
     """Start all workers, stream rank-prefixed logs, fail fast on death.
 
     The reference's static world hangs forever when a rank dies (SURVEY
     §5.3); here a non-zero worker exit terminates the remaining workers with
-    a clear error naming the dead host.
+    a clear error naming the dead host.  ``max_restarts`` relaunches the
+    whole slice job after a failure (checkpoint-restart elasticity: each
+    worker's training engine resumes from its latest snapshot).
+    ``restart_delay`` seconds pass before each relaunch: terminating an ssh
+    client does not instantly kill the remote process, and worker 0's old
+    process may still hold the coordinator port — the delay lets remote
+    processes die of SIGPIPE/EOF and the port free before the new
+    rendezvous starts.
     """
+    attempt = 0
+    while True:
+        rc = _run_once(workers, cmds, poll_interval)
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        print(f"[launcher] attempt {attempt}/{max_restarts}: relaunching "
+              f"{len(workers)} workers in {restart_delay:.0f}s "
+              "(resume from latest checkpoint)", flush=True)
+        time.sleep(restart_delay)
+
+
+def _run_once(workers: list[str], cmds: list[list[str]],
+              poll_interval: float) -> int:
     procs: list[subprocess.Popen] = []
     for cmd in cmds:
         procs.append(subprocess.Popen(
@@ -120,6 +142,9 @@ def main(argv=None) -> int:
     parser.add_argument("--gcloud", default="",
                         help="TPU name to ssh via gcloud instead of raw ssh")
     parser.add_argument("--zone", default="")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="relaunch the whole slice job up to N times "
+                             "after a worker failure (checkpoint-restart)")
     parser.add_argument("--dry-run", action="store_true",
                         help="print per-worker commands and exit")
     parser.add_argument("script", nargs=argparse.REMAINDER,
@@ -135,7 +160,7 @@ def main(argv=None) -> int:
             print(f"[worker {i} {workers[i]}] "
                   + " ".join(shlex.quote(c) for c in cmd))
         return 0
-    return run(workers, cmds)
+    return run(workers, cmds, max_restarts=args.max_restarts)
 
 
 if __name__ == "__main__":

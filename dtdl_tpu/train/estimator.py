@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer
-from dtdl_tpu.data.loader import DataLoader, prefetch_to_device
+from dtdl_tpu.data.loader import DataLoader, prefetch_to_device, resume_iter
 from dtdl_tpu.metrics.report import Reporter, StdoutSink
 from dtdl_tpu.parallel.strategy import SingleDevice, Strategy
 from dtdl_tpu.train.loop import evaluate as _evaluate
@@ -185,13 +185,7 @@ class Estimator:
         last_saved = global_step
         while global_step < target:
             loader.set_epoch(epoch)
-            if skip and hasattr(loader, "iter_from"):
-                raw = loader.iter_from(skip)  # index-level skip: O(1)
-            elif skip:
-                offset = skip  # the lazy generator must not see skip's reset
-                raw = (b for j, b in enumerate(iter(loader)) if j >= offset)
-            else:
-                raw = iter(loader)
+            raw = resume_iter(loader, skip)
             skip = 0
             it = prefetch_to_device(raw, self.strategy.shard_batch, 2)
             for batch in it:

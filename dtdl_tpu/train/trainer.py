@@ -26,7 +26,7 @@ import os
 import time
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer
-from dtdl_tpu.data.loader import prefetch_to_device
+from dtdl_tpu.data.loader import prefetch_to_device, resume_iter
 from dtdl_tpu.metrics.report import Accumulator, JsonlSink, Reporter, StdoutSink
 from dtdl_tpu.parallel.strategy import Strategy
 from dtdl_tpu.runtime.bootstrap import is_leader
@@ -124,19 +124,15 @@ class Trainer:
             self.train_loader.set_epoch(self.epoch)
             self.timer.reset_epoch()
             if self._skip_batches:
-                # mid-epoch resume: the sampler's (seed, epoch) order is
-                # deterministic, so starting at the consumed prefix replays
-                # the exact remainder of the interrupted epoch (Chainer
-                # resume parity — its snapshot serializes the iterator
-                # position, reference chainer/train_mnist.py:120-122).
-                # iter_from skips at the index level (O(1)).
+                # mid-epoch resume: the sampler's (seed, epoch) order and
+                # the per-batch-keyed transform rng are deterministic, so
+                # starting at the consumed prefix replays the exact
+                # remainder of the interrupted epoch (Chainer resume parity
+                # — its snapshot serializes the iterator position, reference
+                # chainer/train_mnist.py:120-122).  O(1) via iter_from.
                 skip = self._skip_batches
                 self._skip_batches = 0
-                if hasattr(self.train_loader, "iter_from"):
-                    raw = self.train_loader.iter_from(skip)
-                else:
-                    raw = (b for i, b in enumerate(iter(self.train_loader))
-                           if i >= skip)
+                raw = resume_iter(self.train_loader, skip)
             else:
                 raw = iter(self.train_loader)
                 self.iteration_in_epoch = 0

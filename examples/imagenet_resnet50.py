@@ -89,12 +89,15 @@ def main():
             state, metrics = train_step(state, batch)
             step_i += 1
             if step_i % args.log_interval == 0 or step_i == args.steps:
-                jax.block_until_ready(metrics["loss"])
+                # a VALUE FETCH, not block_until_ready: on the tunneled TPU
+                # backend the latter returns before execution finishes and
+                # would overstate throughput ~10x (see bench.py)
+                loss = float(metrics["loss"])
                 dt = time.perf_counter() - t0
                 done = step_i - logged
                 sps = args.batch_size * done / dt
                 print(f"step {step_i}/{args.steps} "
-                      f"loss {float(metrics['loss']):.4f} "
+                      f"loss {loss:.4f} "
                       f"acc {float(metrics['accuracy']):.4f} "
                       f"| {sps:,.0f} samples/sec "
                       f"({sps / n_chips:,.0f}/chip, {n_chips} chips) "

@@ -61,3 +61,54 @@ def test_discover_workers_env(monkeypatch):
     assert discover_workers("x,y") == ["x", "y"]
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
     assert discover_workers() == ["localhost"]
+
+
+def test_local_launcher_elastic_restart(tmp_path, capfd):
+    """max_restarts relaunches the whole world after a failure; the retry
+    succeeds (checkpoint-restart elasticity beyond the reference's
+    hang-forever static world, SURVEY §5.3)."""
+    from dtdl_tpu.launch.local import launch_local
+    marker = tmp_path / "crashed_once"
+    prog = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(7)  # first attempt: rank dies\n"
+        "print('recovered ok')\n"
+    )
+    rc = launch_local(["-c", prog], nproc=2, port=12413, timeout=60,
+                      max_restarts=2)
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert "relaunching all 2 ranks" in out
+    assert "recovered ok" in out
+
+
+def test_local_launcher_restart_budget_exhausted(tmp_path):
+    """A permanently failing job still fails after the restart budget."""
+    from dtdl_tpu.launch.local import launch_local
+    rc = launch_local(["-c", "import sys; sys.exit(5)"],
+                      nproc=2, port=12414, timeout=60, max_restarts=1)
+    assert rc == 5
+
+
+def test_tpu_vm_run_elastic_restart(tmp_path, capsys):
+    """tpu_vm.run with max_restarts relaunches the slice after a failure."""
+    from dtdl_tpu.launch.tpu_vm import run
+    marker = tmp_path / "crashed_once"
+    prog = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(9)\n"
+        "print('slice recovered')\n"
+    )
+    cmds = [[sys.executable, "-c", prog] for _ in range(2)]
+    rc = run(["h0", "h1"], cmds, poll_interval=0.1, max_restarts=1,
+             restart_delay=0.1)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "relaunching 2 workers" in out
+    assert "slice recovered" in out
