@@ -31,7 +31,7 @@ class NativeDataLoader:
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  batch_size: int, shuffle: bool = True, seed: int = 0,
                  augment: bool = False, mean=None, std=None,
-                 depth: int = 4, n_threads: int = 4):
+                 depth: int = 4, n_threads: int = 4, sampler=None):
         lib = native.load()
         if lib is None:
             raise RuntimeError("native library unavailable; use "
@@ -67,16 +67,33 @@ class NativeDataLoader:
             raise RuntimeError("dtdl_loader_create failed")
         self._epoch = 0
         self._n = n
+        # a ShardedSampler gives DistributedSampler parity in multi-host
+        # runs: every epoch this host feeds its stripe of a globally
+        # reshuffled permutation (C++ then only augments/batches).  Without
+        # one, the C++ side shuffles the full local array itself.
+        self._sampler = sampler
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+        if self._sampler is not None:
+            self._sampler.set_epoch(epoch)
 
     def __len__(self) -> int:
+        if self._sampler is not None:
+            return len(self._sampler) // self.batch_size
         return self._n // self.batch_size
 
     def __iter__(self):
         lib, h = self._lib, self._h
-        lib.dtdl_loader_start_epoch(h, self._epoch)
+        if self._sampler is not None:
+            idx = np.ascontiguousarray(self._sampler.indices(), np.int64)
+            rc = lib.dtdl_loader_start_epoch_indices(
+                h, self._epoch, idx.ctypes.data_as(ctypes.c_void_p), len(idx))
+            if rc != 0:
+                raise RuntimeError("dtdl_loader_start_epoch_indices failed "
+                                   "(index out of range?)")
+        else:
+            lib.dtdl_loader_start_epoch(h, self._epoch)
         hh, w, c = self._shape
         img = np.empty((self.batch_size, hh, w, c), np.float32)
         lab = np.empty((self.batch_size,), np.int32)
@@ -98,14 +115,19 @@ class NativeDataLoader:
 
     @staticmethod
     def or_python(images, labels, batch_size, shuffle=True, seed=0,
-                  augment=False, mean=None, std=None, **kw):
-        """Native pipeline when buildable, Python DataLoader otherwise."""
+                  augment=False, mean=None, std=None, sampler=None, **kw):
+        """Native pipeline when buildable, Python DataLoader otherwise.
+
+        Both paths honor ``sampler`` (per-host stripe of a per-epoch global
+        permutation), so switching loader backends never changes which
+        examples a host trains on.
+        """
         if native.available():
             try:
                 return NativeDataLoader(images, labels, batch_size,
                                         shuffle=shuffle, seed=seed,
                                         augment=augment, mean=mean, std=std,
-                                        **kw)
+                                        sampler=sampler, **kw)
             except RuntimeError:
                 pass
         from dtdl_tpu.data.loader import (cifar10_train_transform,
@@ -118,7 +140,7 @@ class NativeDataLoader:
         return DataLoader({"image": np.asarray(images, np.float32),
                            "label": np.asarray(labels, np.int32)},
                           batch_size, shuffle=shuffle, seed=seed,
-                          transform=transform)
+                          transform=transform, sampler=sampler)
 
 
 def read_idx_native(path: str):

@@ -62,6 +62,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_int, c.c_int, c.c_int, c.c_int, c.c_uint64,
         c.c_void_p, c.c_void_p]
     lib.dtdl_loader_start_epoch.argtypes = [c.c_void_p, c.c_int]
+    lib.dtdl_loader_start_epoch_indices.restype = c.c_int
+    lib.dtdl_loader_start_epoch_indices.argtypes = [
+        c.c_void_p, c.c_int, c.c_void_p, c.c_int64]
     lib.dtdl_loader_next.restype = c.c_int
     lib.dtdl_loader_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
     lib.dtdl_loader_n_batches.restype = c.c_int64

@@ -184,16 +184,27 @@ void* dtdl_loader_create(const float* images, const int32_t* labels,
   return L;
 }
 
-void dtdl_loader_start_epoch(void* h, int epoch) {
-  Loader* L = (Loader*)h;
-  // join any previous epoch's workers
+static void join_workers(Loader* L) {
   L->stop.store(true);
   L->cv_free.notify_all();
   for (auto& t : L->workers) t.join();
   L->workers.clear();
   L->stop.store(false);
+}
 
+static void begin_epoch(Loader* L, int epoch, int64_t n_indices) {
   L->epoch = epoch;
+  L->n_batches = n_indices / L->batch;  // drop_last semantics
+  L->next_build.store(0);
+  L->next_emit = 0;
+  for (auto& B : L->slots) { B.ready = false; B.index = -1; }
+  for (int i = 0; i < L->n_threads; ++i)
+    L->workers.emplace_back(worker_loop, L);
+}
+
+void dtdl_loader_start_epoch(void* h, int epoch) {
+  Loader* L = (Loader*)h;
+  join_workers(L);
   L->perm.resize(L->n);
   for (int64_t i = 0; i < L->n; ++i) L->perm[i] = i;
   if (L->flags & DTDL_SHUFFLE) {
@@ -203,12 +214,23 @@ void dtdl_loader_start_epoch(void* h, int epoch) {
       std::swap(L->perm[i], L->perm[j]);
     }
   }
-  L->n_batches = L->n / L->batch;  // drop_last semantics
-  L->next_build.store(0);
-  L->next_emit = 0;
-  for (auto& B : L->slots) { B.ready = false; B.index = -1; }
-  for (int i = 0; i < L->n_threads; ++i)
-    L->workers.emplace_back(worker_loop, L);
+  begin_epoch(L, epoch, L->n);
+}
+
+// Start an epoch over caller-provided sample indices (e.g. a sharded
+// sampler's per-epoch stripe of a globally reshuffled permutation —
+// DistributedSampler parity in multi-host runs).  Indices are copied;
+// values must lie in [0, n).  Returns 0, or -1 on invalid input.
+int dtdl_loader_start_epoch_indices(void* h, int epoch,
+                                    const int64_t* indices, int64_t count) {
+  Loader* L = (Loader*)h;
+  if (!indices || count <= 0) return -1;
+  for (int64_t i = 0; i < count; ++i)
+    if (indices[i] < 0 || indices[i] >= L->n) return -1;
+  join_workers(L);
+  L->perm.assign(indices, indices + count);
+  begin_epoch(L, epoch, count);
+  return 0;
 }
 
 // returns 1 and fills outputs, or 0 at end of epoch

@@ -63,8 +63,12 @@ def add_data_flags(parser, dataset="mnist"):
     flag(parser, "--dataset-dir", "--dataset_dir", type=str, default="./datasets",
          help="root containing mnist/*.gz or cifar-10 batches; synthetic "
               "data is generated deterministically when files are absent")
+    # no "-j" short alias: the TF2 multi-worker example uses -j for
+    # --job_name (reference tensorflow2/mnist_multi_worker_strategy.py flags)
     flag(parser, "--num-workers", type=int, default=0,
-         help="host-side prefetch depth (0 = synchronous)")
+         help="native C++ pipeline worker threads for the train loader "
+              "(0 = pure-Python loader; the reference's DataLoader "
+              "num_workers)")
     flag(parser, "--limit-train", type=int, default=0,
          help="truncate the train set to N examples (0 = full); for smoke "
               "tests and demos")

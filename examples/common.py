@@ -60,18 +60,27 @@ def build_mesh_from_args(args):
     return build_mesh(shape, axes)
 
 
-def per_process_loader(images, labels, global_batch: int, *, shuffle: bool,
-                       seed: int, transform=None, drop_last: bool = True):
-    """Loader feeding this host's stripe of the global batch."""
+def _host_batch_and_sampler(n_examples: int, global_batch: int, *,
+                            shuffle: bool, seed: int):
+    """(per-host batch, this host's ShardedSampler) — the one place the
+    global-batch split and dataset partition are decided."""
     nproc = jax.process_count()
     if global_batch % nproc:
         raise ValueError(f"global batch {global_batch} not divisible by "
                          f"{nproc} processes")
-    sampler = ShardedSampler(len(labels), nproc, jax.process_index(),
+    sampler = ShardedSampler(n_examples, nproc, jax.process_index(),
                              shuffle=shuffle, seed=seed)
-    return DataLoader({"image": images, "label": labels},
-                      global_batch // nproc, sampler=sampler,
-                      drop_last=drop_last, transform=transform)
+    return global_batch // nproc, sampler
+
+
+def per_process_loader(images, labels, global_batch: int, *, shuffle: bool,
+                       seed: int, transform=None, drop_last: bool = True):
+    """Loader feeding this host's stripe of the global batch."""
+    batch, sampler = _host_batch_and_sampler(
+        len(labels), global_batch, shuffle=shuffle, seed=seed)
+    return DataLoader({"image": images, "label": labels}, batch,
+                      sampler=sampler, drop_last=drop_last,
+                      transform=transform)
 
 
 def _limit(args, train, test):
@@ -89,12 +98,34 @@ def _limit(args, train, test):
 def cifar_loaders(args, seed: int):
     """CIFAR-10 train/val loaders with the reference's augmentation
     (RandomCrop(32, pad 4) + flip + normalize, reference
-    pytorch/single_gpu.py:51-55)."""
+    pytorch/single_gpu.py:51-55).
+
+    ``--num-workers N`` (N > 0) routes the train pipeline through the native
+    C++ producer/consumer loader — augment/normalize/batch on N worker
+    threads, the role torch DataLoader's ``num_workers=4`` processes play
+    for the reference (pytorch/single_gpu.py:21,60-61).  Both paths use the
+    same ShardedSampler (per-host stripe of a per-epoch global
+    permutation), so the loader backend never changes which examples a host
+    trains on or the cross-host mixing semantics.
+    """
     (xtr, ytr), (xte, yte) = _limit(
         args, *load_dataset("cifar10", args.dataset_dir))
-    train = per_process_loader(
-        xtr, ytr, args.batch_size, shuffle=True, seed=seed,
-        transform=cifar10_train_transform(CIFAR10_MEAN, CIFAR10_STD))
+    workers = getattr(args, "num_workers", 0)
+    batch, sampler = _host_batch_and_sampler(
+        len(ytr), args.batch_size, shuffle=True, seed=seed)
+    if workers > 0:
+        from dtdl_tpu.data.native_loader import NativeDataLoader
+        train = NativeDataLoader.or_python(
+            xtr, ytr, batch, seed=seed, augment=True,
+            mean=CIFAR10_MEAN, std=CIFAR10_STD, n_threads=workers,
+            sampler=sampler)
+        if jax.process_index() == 0:
+            print(f"train loader: {type(train).__name__} "
+                  f"({workers} workers)", flush=True)
+    else:
+        train = DataLoader(
+            {"image": xtr, "label": ytr}, batch, sampler=sampler,
+            transform=cifar10_train_transform(CIFAR10_MEAN, CIFAR10_STD))
     val = per_process_loader(
         xte, yte, args.batch_size, shuffle=False, seed=seed,
         transform=normalize_transform(CIFAR10_MEAN, CIFAR10_STD),

@@ -176,3 +176,38 @@ def test_imagenet_resnet50_example(tmp_path):
         "--dataset-dir", str(tmp_path / "none"), timeout=600)
     assert "samples/sec" in out
     assert re.search(r"step 6/6", out), out
+
+
+def test_ddp_example_native_loader(tmp_path):
+    """--num-workers routes the train pipeline through the native C++
+    loader (falls back to Python transparently when unbuildable)."""
+    from dtdl_tpu import native
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    out = run_example(
+        "distributed_data_parallel.py", "--batch-size", "32",
+        "--epochs", "1", "--num-workers", "2",
+        "--limit-train", "128", "--limit-test", "64",
+        "--dataset-dir", str(tmp_path / "none"),
+        "--out", str(tmp_path / "o"), "--dtype", "float32", timeout=600)
+    assert "DDP over 4 replicas" in out
+    # the native loader actually ran (a silent Python fallback would pass
+    # the other assertions too)
+    assert "train loader: NativeDataLoader (2 workers)" in out
+    assert "leader saved weights" in out
+
+
+@pytest.mark.parametrize("script", [
+    "single_device.py", "data_parallel.py", "distributed_data_parallel.py",
+    "mnist_single.py", "mnist_mirror_strategy.py",
+    "mnist_multi_worker_strategy.py", "train_mnist.py", "train_mnist_gpu.py",
+    "train_mnist_multi.py", "mxnet_kvstore.py", "caffe_train.py",
+    "tf_estimator.py", "train_lm.py", "train_lm_4d.py",
+    "imagenet_resnet50.py",
+])
+def test_every_example_parses_help(script):
+    """Flag-surface smoke: argparse must build without alias collisions."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EX, script), "--help"],
+        capture_output=True, text=True, timeout=120, env=CPU_ENV, cwd=EX)
+    assert proc.returncode == 0, f"{script} --help failed:\n{proc.stderr}"
