@@ -111,10 +111,10 @@ def cifar_loaders(args, seed: int):
     (xtr, ytr), (xte, yte) = _limit(
         args, *load_dataset("cifar10", args.dataset_dir))
     workers = getattr(args, "num_workers", 0)
-    batch, sampler = _host_batch_and_sampler(
-        len(ytr), args.batch_size, shuffle=True, seed=seed)
     if workers > 0:
         from dtdl_tpu.data.native_loader import NativeDataLoader
+        batch, sampler = _host_batch_and_sampler(
+            len(ytr), args.batch_size, shuffle=True, seed=seed)
         train = NativeDataLoader.or_python(
             xtr, ytr, batch, seed=seed, augment=True,
             mean=CIFAR10_MEAN, std=CIFAR10_STD, n_threads=workers,
@@ -123,8 +123,8 @@ def cifar_loaders(args, seed: int):
             print(f"train loader: {type(train).__name__} "
                   f"({workers} workers)", flush=True)
     else:
-        train = DataLoader(
-            {"image": xtr, "label": ytr}, batch, sampler=sampler,
+        train = per_process_loader(
+            xtr, ytr, args.batch_size, shuffle=True, seed=seed,
             transform=cifar10_train_transform(CIFAR10_MEAN, CIFAR10_STD))
     val = per_process_loader(
         xte, yte, args.batch_size, shuffle=False, seed=seed,
