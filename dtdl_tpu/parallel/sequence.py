@@ -57,7 +57,6 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
-    q32 = q.astype(jnp.float32)
     pos_q = my * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
 
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -65,22 +64,36 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     def step(carry, t):
         k_blk, v_blk, o, m, l = carry
         src = (my - t) % n                        # original owner of k_blk
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
-                       preferred_element_type=jnp.float32) * scale
+
+        def attend(o, m, l):
+            # native-dtype (bf16) matmul inputs, f32 accumulation — the MXU
+            # runs bf16 at 2x f32 throughput (same contract as the Pallas
+            # flash kernel, dtdl_tpu/ops/attention.py)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                           preferred_element_type=jnp.float32) * scale
+            if causal:
+                pos_k = src * s_loc + lax.broadcasted_iota(
+                    jnp.int32, (s_loc, s_loc), 1)
+                s = jnp.where(pos_q >= pos_k, s, NEG_INF)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            o_new = o * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32)
+            return o_new, m_new, l_new
+
         if causal:
-            pos_k = src * s_loc + lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 1)
-            s = jnp.where(pos_q >= pos_k, s, NEG_INF)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        o_new = o * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
-            preferred_element_type=jnp.float32)
+            # blocks strictly above the diagonal (src > my) are fully
+            # masked: skip their matmuls entirely — half the ring's FLOPs
+            o, m, l = lax.cond(src <= my, attend,
+                               lambda o, m, l: (o, m, l), o, m, l)
+        else:
+            o, m, l = attend(o, m, l)
         k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
-        return (k_blk, v_blk, o_new, m_new, l_new), None
+        return (k_blk, v_blk, o, m, l), None
 
     from dtdl_tpu.parallel.collectives import pvary_like
     o0 = pvary_like(jnp.zeros((b, h, s_loc, d), jnp.float32), q, k, v)
