@@ -87,7 +87,13 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
 
         if causal:
             # blocks strictly above the diagonal (src > my) are fully
-            # masked: skip their matmuls entirely — half the ring's FLOPs
+            # masked: skip their matmuls.  This halves aggregate FLOPs
+            # (energy), but NOT the critical path — with the contiguous
+            # layout some device attends at every ring step, so per-step
+            # wall time is unchanged; converting the saving into ~2x time
+            # needs a zigzag position assignment (each device holding one
+            # low and one high block), a layout-contract change left for a
+            # later round.
             o, m, l = lax.cond(src <= my, attend,
                                lambda o, m, l: (o, m, l), o, m, l)
         else:

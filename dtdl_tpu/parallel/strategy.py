@@ -110,9 +110,16 @@ class SingleDevice(Strategy):
 
 
 class MeshStrategy(Strategy):
-    """Shared mesh-bearing behavior: batch/state placement over a mesh."""
+    """Shared mesh-bearing behavior: batch/state placement over a mesh.
 
-    def __init__(self, mesh: Mesh | None = None, axis: str = DATA_AXIS):
+    ``axis`` may be a tuple of mesh axes for hierarchical data parallelism
+    (e.g. ``('dcn', 'data')`` over a `hybrid_mesh`): the batch shards over
+    all of them and gradient allreduces name them all, so XLA emits the
+    in-slice ICI reduce and the cross-slice DCN reduce as one hierarchy.
+    """
+
+    def __init__(self, mesh: Mesh | None = None,
+                 axis: str | tuple[str, ...] = DATA_AXIS):
         self.mesh = mesh if mesh is not None else build_mesh()
         self.axis = axis
 
@@ -137,6 +144,11 @@ class MeshStrategy(Strategy):
 
     @property
     def num_replicas(self) -> int:
+        if isinstance(self.axis, tuple):
+            out = 1
+            for a in self.axis:
+                out *= self.mesh.shape[a]
+            return out
         return self.mesh.shape[self.axis]
 
 
@@ -164,7 +176,9 @@ class DataParallel(MeshStrategy):
         return collectives.all_reduce_mean(tree, self.axis)
 
     def fold_rank(self, key):
-        # each replica draws its own dropout mask, like per-rank DDP workers
+        # each replica draws its own dropout mask, like per-rank DDP
+        # workers; axis_index flattens tuple axes row-major, matching the
+        # P((...)) batch-sharding order
         return jax.random.fold_in(key, jax.lax.axis_index(self.axis))
 
     def compile(self, step_fn, donate_state: bool = True):

@@ -1,3 +1,5 @@
 from dtdl_tpu.runtime.bootstrap import initialize, is_leader, barrier  # noqa: F401
-from dtdl_tpu.runtime.mesh import build_mesh, local_mesh, DATA_AXIS, MODEL_AXIS  # noqa: F401
+from dtdl_tpu.runtime.mesh import (  # noqa: F401
+    build_mesh, hybrid_mesh, local_mesh, DATA_AXIS, DCN_AXIS, MODEL_AXIS,
+)
 from dtdl_tpu.runtime.topology import describe_topology  # noqa: F401

@@ -50,6 +50,71 @@ def build_mesh(shape: tuple[int, ...] | None = None,
     return Mesh(dev_array, axes)
 
 
+DCN_AXIS = "dcn"
+
+
+def hybrid_mesh(ici_shape: tuple[int, ...] | None = None,
+                ici_axes: tuple[str, ...] = (DATA_AXIS,),
+                dcn_axis: str = DCN_AXIS,
+                num_slices: int | None = None,
+                devices=None) -> Mesh:
+    """Multi-slice mesh: a leading DCN axis over slices, ICI axes within.
+
+    The scaling-book layout for pods-of-slices: collectives named over the
+    ICI axes ride the slice's torus; only the ``dcn_axis`` dimension crosses
+    the data-center network.  ``DataParallel(mesh, axis=(dcn_axis,) +
+    ici_axes)`` then does hierarchical allreduce data parallelism across
+    everything.
+
+    Slice membership comes from each device's ``slice_index`` when the
+    platform provides it; otherwise (CPU test meshes, single-slice TPUs)
+    pass ``num_slices`` to split devices into equal synthetic slices, or
+    the process boundary is used (one "slice" per host — the DCN boundary
+    in multi-host CPU testing).
+    """
+    if devices is None:
+        devices = jax.devices()
+    slice_ids = sorted({getattr(d, "slice_index", None) for d in devices},
+                       key=lambda s: (s is None, s))
+    detected = len(slice_ids) > 1 and slice_ids[0] is not None
+    if detected and num_slices is not None and num_slices != len(slice_ids):
+        raise ValueError(
+            f"num_slices={num_slices} conflicts with the platform's "
+            f"{len(slice_ids)} detected slices")
+    if detected:
+        groups = [[d for d in devices if d.slice_index == s]
+                  for s in slice_ids]
+    else:
+        # single real slice (slice_index uniform) or no slice info (CPU):
+        # an explicit num_slices splits synthetically — for testing the
+        # hierarchical path and for DCN-connected single-slice groups
+        if num_slices is None:
+            num_slices = max(1, jax.process_count())
+        if len(devices) % num_slices:
+            raise ValueError(f"{len(devices)} devices not divisible into "
+                             f"{num_slices} slices")
+        per = len(devices) // num_slices
+        groups = [list(devices[i * per:(i + 1) * per])
+                  for i in range(num_slices)]
+    per = len(groups[0])
+    if any(len(g) != per for g in groups):
+        raise ValueError(
+            f"unequal slice sizes {[len(g) for g in groups]}")
+    if ici_shape is None:
+        ici_shape = (per,)
+    if int(np.prod(ici_shape)) != per:
+        raise ValueError(f"ici shape {ici_shape} needs "
+                         f"{int(np.prod(ici_shape))} devices/slice, have {per}")
+    rows = []
+    for g in groups:
+        if len(ici_shape) == 1:
+            rows.append(np.asarray(g).reshape(ici_shape))
+        else:  # ICI-neighbor layout within the slice
+            rows.append(mesh_utils.create_device_mesh(ici_shape, devices=g))
+    dev_array = np.stack(rows, axis=0)
+    return Mesh(dev_array, (dcn_axis,) + tuple(ici_axes))
+
+
 def local_mesh(axes: tuple[str, ...] = (DATA_AXIS,)) -> Mesh:
     """Mesh over this process's local devices only.
 
