@@ -11,14 +11,24 @@ gradient synchronization as XLA collectives over ICI/DCN instead of NCCL/MPI.
 Subpackages
 -----------
 runtime   process bootstrap, topology discovery, mesh construction
-parallel  parallelism strategies (DP/DDP), collectives adapter
-models    MLP / MNIST-CNN / PyramidNet / ResNet flax modules
-ops       classification losses (XLA-fused; pallas kernels as they pay off)
-train     jitted train-step engine (state, train/eval/predict steps)
-utils     flags, seeding, timing
+          (incl. multi-slice hybrid DCN x ICI meshes)
+parallel  strategies (SingleDevice / DataParallel incl. hierarchical /
+          AutoSharded / KVStore), collectives adapter, ring & Ulysses
+          sequence parallelism, 4D megatron (dp x sp x pp x tp + ep)
+models    MLP / MNIST-CNN / PyramidNet / ResNet-50 / TransformerLM /
+          CaffeNet (prototxt-built) flax modules
+ops       flash attention (Pallas TPU kernel), RoPE, classification losses
+data      dataset registry, sharded sampling, Python + native C++ loaders
+train     jitted step engines and five API flavors: imperative loop,
+          Keras fit(), Chainer Trainer, TF1 Estimator, Caffe Solver
+ckpt      leader-gated checkpointing (weights / per-epoch / full state)
+metrics   metrics bus (stdout / JSONL / TensorBoard sinks)
+launch    local, TPU-VM slice, and SLURM launchers (fail-fast +
+          checkpoint-restart elasticity)
+utils     flags, seeding, timing, profiling, prototxt parsing
 """
 
 __version__ = "0.1.0"
 
-from dtdl_tpu.runtime.mesh import build_mesh, local_mesh  # noqa: F401
+from dtdl_tpu.runtime.mesh import build_mesh, hybrid_mesh, local_mesh  # noqa: F401
 from dtdl_tpu.runtime.bootstrap import initialize, is_leader  # noqa: F401
