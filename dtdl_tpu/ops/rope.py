@@ -24,14 +24,20 @@ def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0,
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
 
-def apply_rope(x, cos, sin, offset=0):
+def apply_rope(x, cos, sin, offset=0, positions=None):
     """Rotate [batch, heads, seq, head_dim] queries/keys.
 
     ``offset`` (int or traced scalar) is the global position of the shard's
-    first token — the hook sequence parallelism uses.
+    first token — the hook contiguous sequence parallelism uses.
+    ``positions`` ([seq] int array, overrides ``offset``) gives each local
+    row an arbitrary global position — the hook the zigzag ring layout uses
+    (dtdl_tpu/parallel/sequence.py zigzag_positions).
     """
     seq = x.shape[2]
-    if isinstance(offset, int) and offset == 0:
+    if positions is not None:
+        c = jnp.take(cos, positions, axis=0)
+        s = jnp.take(sin, positions, axis=0)
+    elif isinstance(offset, int) and offset == 0:
         c, s = cos[:seq], sin[:seq]
     else:
         c = jnp.take(cos, offset + jnp.arange(seq), axis=0)

@@ -7,7 +7,8 @@ from dtdl_tpu.parallel.kvstore import (  # noqa: F401
     KVStore, KVStoreStrategy, kvstore_strategy,
 )
 from dtdl_tpu.parallel.sequence import (  # noqa: F401
-    ring_attention, ulysses_attention,
+    ring_attention, ulysses_attention, zigzag_inverse, zigzag_order,
+    zigzag_positions,
 )
 from dtdl_tpu.parallel.megatron import (  # noqa: F401
     MegatronConfig, build_4d_mesh, factor_mesh,

@@ -10,8 +10,11 @@ explicit so they can be audited and scheduled:
   VMA-typed autodiff (the loss psum over 'data' transposes to the allreduce
   DDP fires from its grad hooks, reference
   pytorch/distributed_data_parallel.py:74,132).
-* **sp**  — sequence sharded over 'seq'; **ring attention** rotates K/V via
-  ``lax.ppermute`` (dtdl_tpu/parallel/sequence.py) — one ICI hop per step.
+* **sp**  — sequence sharded over 'seq' in the **zigzag layout** (each
+  shard holds one low + one high chunk, so causal masking is
+  load-balanced); **ring attention** rotates K/V via ``lax.ppermute``
+  (dtdl_tpu/parallel/sequence.py) — one ICI hop per step, half a block of
+  matmul per device per step.
 * **pp**  — layers stacked ``[n_stages, layers_per_stage, ...]`` and sharded
   over 'pipe'; a GPipe microbatch schedule runs as a ``lax.scan`` over
   ticks with a ``ppermute`` stage-to-stage handoff.  Autodiff through the
@@ -38,11 +41,14 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
-from dtdl_tpu.parallel.sequence import ring_attention
+from dtdl_tpu.parallel.sequence import (
+    ring_attention, zigzag_order, zigzag_positions,
+)
 
 DATA, SEQ, PIPE, MODEL = "data", "seq", "pipe", "model"
 AXES = (DATA, SEQ, PIPE, MODEL)
@@ -188,10 +194,13 @@ def _attention(cfg, p, x, cos, sin):
         return y.reshape(b, s_loc, h_loc, cfg.head_dim).transpose(0, 2, 1, 3)
 
     q, k, v = proj(p["wq"]), proj(p["wk"]), proj(p["wv"])
-    offset = lax.axis_index(SEQ) * s_loc
-    q = apply_rope(q, cos, sin, offset=offset)
-    k = apply_rope(k, cos, sin, offset=offset)
-    o = ring_attention(q, k, v, axis_name=SEQ, causal=True)
+    # zigzag layout: each 'seq' shard holds one low and one high chunk so
+    # causal ring attention is load-balanced; RoPE uses true global
+    # positions of the zigzag rows (shard_lm_batch lays the batch out).
+    pos = zigzag_positions(SEQ, s_loc)
+    q = apply_rope(q, cos, sin, positions=pos)
+    k = apply_rope(k, cos, sin, positions=pos)
+    o = ring_attention(q, k, v, axis_name=SEQ, causal=True, layout="zigzag")
     o = o.transpose(0, 2, 1, 3).reshape(b, s_loc, h_loc * cfg.head_dim)
     y = jnp.einsum("bsh,hd->bsd", o, p["wo"].astype(cfg.dtype))
     return lax.psum(y, MODEL)                    # row-parallel combine
@@ -391,7 +400,32 @@ def init_optimizer(cfg: MegatronConfig, mesh: Mesh, optimizer, params):
 
 
 def shard_lm_batch(mesh: Mesh, batch: dict) -> dict:
-    """Place tokens/targets/mask as [batch@'data', seq@'seq'] global arrays."""
+    """Place tokens/targets/mask as [batch@'data', seq@'seq'] global arrays.
+
+    When the mesh has a 'seq' axis > 1 the sequence dim is permuted into the
+    **zigzag order** first (dtdl_tpu/parallel/sequence.py zigzag_order) —
+    the layout contract of the 4D step's causal ring attention.  The LM loss
+    is a masked mean over positions, so the permutation changes nothing
+    observable; callers that need position-ordered logits apply
+    ``zigzag_inverse``.  (Multi-host note: the permutation is applied to
+    each process's local view, which is exact as long as the 'seq' axis
+    does not span processes — the standard placement, dp over DCN —
+    enforced below.)
+    """
+    n_sp = mesh.shape[SEQ]
+    if n_sp > 1:
+        if jax.process_count() > 1:
+            # a process-spanning 'seq' axis would make the local-view
+            # permutation silently wrong — refuse instead
+            seq_axis = mesh.axis_names.index(SEQ)
+            rows = np.moveaxis(mesh.devices, seq_axis, -1).reshape(-1, n_sp)
+            for row in rows:
+                if len({d.process_index for d in row}) != 1:
+                    raise ValueError(
+                        "zigzag shard_lm_batch requires the 'seq' mesh axis "
+                        "to be process-local; lay 'data' over DCN instead")
+        order = zigzag_order(n_sp, next(iter(batch.values())).shape[1])
+        batch = {k: np.asarray(v)[:, order] for k, v in batch.items()}
     sharding = NamedSharding(mesh, P(DATA, SEQ))
     if jax.process_count() == 1:
         return {k: jax.device_put(v, sharding) for k, v in batch.items()}

@@ -14,6 +14,18 @@ activations sharded on a ``seq`` mesh axis:
   O(S_local) memory per device; the full S×S score matrix never exists on
   any one chip.  Differentiable by construction (scan + ppermute transpose).
 
+  Two sequence layouts are supported.  ``contiguous`` (device i holds
+  positions ``[i·s_loc, (i+1)·s_loc)``) is the simple contract, but under
+  causal masking its work is imbalanced: device n-1 attends at every ring
+  step while device 0 attends once, so skipping masked blocks saves FLOPs
+  without shortening the critical path.  ``zigzag`` splits the sequence
+  into ``2n`` chunks and gives device i chunk ``i`` (low) plus chunk
+  ``2n-1-i`` (high); every device then does exactly half a block of causal
+  work at every ring step — the causal saving becomes ~2× *wall-clock*,
+  not just energy.  Use :func:`zigzag_order` to lay a global batch out in
+  zigzag shard order (loss terms are position-permutation-invariant, so
+  training code only needs the forward permutation).
+
 * **Ulysses** (all-to-all head/sequence transpose): one ``lax.all_to_all``
   re-shards activations from sequence-sharded to head-sharded, local flash
   attention (the Pallas kernel from dtdl_tpu.ops.attention) runs over the
@@ -32,6 +44,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 SEQ_AXIS = "seq"
@@ -42,15 +55,87 @@ def _axis_size(axis_name: str) -> int:
     return lax.axis_size(axis_name)
 
 
+def zigzag_order(n_shards: int, seq_len: int) -> np.ndarray:
+    """Gather indices laying a global sequence out in zigzag shard order.
+
+    ``x[..., zigzag_order(n, S), ...]`` (applied to the sequence dim) is the
+    array to feed a ``P(..., 'seq', ...)`` sharding so shard i receives
+    chunks ``(i, 2n-1-i)`` of the original order.  Identity when n == 1.
+    """
+    if n_shards <= 1:
+        return np.arange(seq_len)
+    if seq_len % (2 * n_shards):
+        raise ValueError(
+            f"zigzag layout needs seq_len ({seq_len}) divisible by "
+            f"2*n_shards ({2 * n_shards})")
+    c = seq_len // (2 * n_shards)
+    parts = []
+    for i in range(n_shards):
+        parts.append(np.arange(i * c, (i + 1) * c))
+        j = 2 * n_shards - 1 - i
+        parts.append(np.arange(j * c, (j + 1) * c))
+    return np.concatenate(parts)
+
+
+def zigzag_inverse(n_shards: int, seq_len: int) -> np.ndarray:
+    """Scatter indices undoing :func:`zigzag_order` (for outputs that must
+    return to the original position order, e.g. sampled logits)."""
+    return np.argsort(zigzag_order(n_shards, seq_len))
+
+
+def zigzag_positions(axis_name: str, s_loc: int):
+    """Global position of each local row under the zigzag layout: [s_loc]."""
+    n = _axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    if n == 1:
+        return jnp.arange(s_loc)
+    c = s_loc // 2
+    low = my * c + jnp.arange(c)
+    high = (2 * n - 1 - my) * c + jnp.arange(c)
+    return jnp.concatenate([low, high])
+
+
+def _online_update(q_rows, k_blk, v_blk, o, m, l, scale, mask=None):
+    """One online-softmax accumulation of (o, m, l) rows against a K/V block.
+
+    bf16 (native-dtype) matmul inputs with f32 accumulation — the MXU runs
+    bf16 at 2x f32 throughput (same contract as the Pallas flash kernel,
+    dtdl_tpu/ops/attention.py).  Shared by both ring schedules.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q_rows, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
+    return o_new, m_new, l_new
+
+
 def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
-                   causal: bool = True, scale: float | None = None):
+                   causal: bool = True, scale: float | None = None,
+                   layout: str = "contiguous"):
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
     Call inside ``shard_map``; q/k/v are the local shards
-    ``[batch, heads, seq_local, head_dim]`` of a global sequence laid out
-    contiguously along the axis (device i holds positions
-    ``[i*seq_local, (i+1)*seq_local)``).  Returns the local output shard.
+    ``[batch, heads, seq_local, head_dim]`` of a global sequence.  With
+    ``layout='contiguous'`` device i holds positions
+    ``[i*seq_local, (i+1)*seq_local)``; with ``layout='zigzag'`` it holds
+    chunks ``i`` and ``2n-1-i`` of a ``2n``-chunk split (build the global
+    order with :func:`zigzag_order`) — the layout that load-balances causal
+    masking across the ring.  Returns the local output shard (same layout).
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "zigzag" and causal and _axis_size(axis_name) > 1:
+        return _ring_zigzag_causal(q, k, v, axis_name=axis_name, scale=scale)
+    # non-causal attention touches every block regardless of layout, so the
+    # zigzag non-causal case is exactly the contiguous schedule below.
     n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
@@ -66,34 +151,18 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
         src = (my - t) % n                        # original owner of k_blk
 
         def attend(o, m, l):
-            # native-dtype (bf16) matmul inputs, f32 accumulation — the MXU
-            # runs bf16 at 2x f32 throughput (same contract as the Pallas
-            # flash kernel, dtdl_tpu/ops/attention.py)
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
-                           preferred_element_type=jnp.float32) * scale
+            mask = None
             if causal:
                 pos_k = src * s_loc + lax.broadcasted_iota(
                     jnp.int32, (s_loc, s_loc), 1)
-                s = jnp.where(pos_q >= pos_k, s, NEG_INF)
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m, m_cur)
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            o_new = o * alpha + jnp.einsum(
-                "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
-                preferred_element_type=jnp.float32)
-            return o_new, m_new, l_new
+                mask = pos_q >= pos_k
+            return _online_update(q, k_blk, v_blk, o, m, l, scale, mask)
 
         if causal:
             # blocks strictly above the diagonal (src > my) are fully
-            # masked: skip their matmuls.  This halves aggregate FLOPs
-            # (energy), but NOT the critical path — with the contiguous
-            # layout some device attends at every ring step, so per-step
-            # wall time is unchanged; converting the saving into ~2x time
-            # needs a zigzag position assignment (each device holding one
-            # low and one high block), a layout-contract change left for a
-            # later round.
+            # masked: skip their matmuls.  Under the contiguous layout this
+            # saves FLOPs but not critical path (device n-1 attends every
+            # step); the zigzag layout above is the balanced schedule.
             o, m, l = lax.cond(src <= my, attend,
                                lambda o, m, l: (o, m, l), o, m, l)
         else:
@@ -107,6 +176,75 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     l0 = pvary_like(jnp.zeros((b, h, s_loc, 1), jnp.float32), q, k, v)
     (k, v, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0), jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)               # fully-masked rows (non-causal corner)
+    return (o / l).astype(q.dtype)
+
+
+def _ring_zigzag_causal(q, k, v, *, axis_name: str, scale: float | None):
+    """Causal ring attention over the zigzag layout — balanced schedule.
+
+    Device i holds chunks ``(i, 2n-1-i)`` of a ``2n``-chunk global split.
+    For a K/V block owned by ``src``:
+
+    * ``src == my`` — the local diagonal: full block, zigzag causal mask
+      (handled once, statically, before the rotation scan).
+    * ``src < my`` — both kv chunks of ``src`` relate to my chunks as:
+      low→(both my chunks) unmasked, high→(both) fully masked.  So attend
+      **all local queries to the kv low chunk only** — half a block, no mask.
+    * ``src > my`` — low kv chunk is visible only to my high chunk; high kv
+      chunk (= chunk ``2n-1-src`` < ``2n-1-my``) is also visible only to my
+      high chunk.  So attend **my high-chunk queries to the full kv block**
+      — half a block, no mask.
+
+    Every device therefore does exactly half a block of matmul per ring
+    step: the causal FLOP saving is also a critical-path saving, unlike the
+    contiguous layout's skip.
+    """
+    n = _axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    if s_loc % 2:
+        raise ValueError(f"zigzag needs an even local seq, got {s_loc}")
+    c = s_loc // 2
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    def attend(q_rows, k_blk, v_blk, o, m, l, mask=None):
+        return _online_update(q_rows, k_blk, v_blk, o, m, l, scale, mask)
+
+    from dtdl_tpu.parallel.collectives import pvary_like
+    o0 = pvary_like(jnp.zeros((b, h, s_loc, d), jnp.float32), q, k, v)
+    m0 = pvary_like(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32), q, k, v)
+    l0 = pvary_like(jnp.zeros((b, h, s_loc, 1), jnp.float32), q, k, v)
+
+    # step 0: local diagonal, full block under the zigzag causal mask
+    pos = zigzag_positions(axis_name, s_loc)
+    o, m, l = attend(q, k, v, o0, m0, l0,
+                     mask=pos[:, None] >= pos[None, :])
+    if n == 1:
+        return (o / l).astype(q.dtype)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, o, m, l = carry
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        src = (my - t) % n
+
+        def from_earlier(o, m, l):           # src < my: q_all vs kv low chunk
+            return attend(q, k_blk[:, :, :c], v_blk[:, :, :c], o, m, l)
+
+        def from_later(o, m, l):             # src > my: q high chunk vs kv all
+            o_hi, m_hi, l_hi = attend(
+                q[:, :, c:], k_blk, v_blk,
+                o[:, :, c:], m[:, :, c:], l[:, :, c:])
+            return (jnp.concatenate([o[:, :, :c], o_hi], axis=2),
+                    jnp.concatenate([m[:, :, :c], m_hi], axis=2),
+                    jnp.concatenate([l[:, :, :c], l_hi], axis=2))
+
+        o, m, l = lax.cond(src < my, from_earlier, from_later, o, m, l)
+        return (k_blk, v_blk, o, m, l), None
+
+    (k, v, o, m, l), _ = lax.scan(step, (k, v, o, m, l), jnp.arange(1, n))
     return (o / l).astype(q.dtype)
 
 

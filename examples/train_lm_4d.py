@@ -82,8 +82,9 @@ def main():
             (args.batch_size // shape["data"]) % args.microbatches:
         raise SystemExit("--batch-size must be divisible by data-axis size "
                          "times --microbatches")
-    if args.seq_len % shape["seq"]:
-        raise SystemExit("--seq-len must be divisible by the seq-axis size")
+    if shape["seq"] > 1 and args.seq_len % (2 * shape["seq"]):
+        raise SystemExit("--seq-len must be divisible by 2x the seq-axis "
+                         "size (zigzag ring layout)")
     params = M.place_params(mesh, cfg,
                             M.init_params(cfg, jax.random.PRNGKey(args.seed)))
     opt = optax.adamw(args.lr)

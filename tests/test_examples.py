@@ -32,6 +32,7 @@ def run_example(script, *args, timeout=420):
     return proc.stdout
 
 
+@pytest.mark.slow
 def test_mnist_single_example(tmp_path):
     out = run_example(
         "mnist_single.py", "--batch_size", "64", "--epochs", "4",
@@ -45,6 +46,7 @@ def test_mnist_single_example(tmp_path):
     assert (tmp_path / "td" / "weights_epoch_0003.msgpack").exists()
 
 
+@pytest.mark.slow
 def test_mnist_mirror_strategy_example(tmp_path):
     out = run_example(
         "mnist_mirror_strategy.py", "--batch_size", "64", "--epochs", "1",
@@ -54,6 +56,7 @@ def test_mnist_mirror_strategy_example(tmp_path):
     assert "Mirrored DP over 4 local device(s)" in out
 
 
+@pytest.mark.slow
 def test_train_mnist_example_with_resume(tmp_path):
     out_dir = str(tmp_path / "result")
     common = ["-b", "100", "-u", "64", "--limit-train", "500",
@@ -69,6 +72,7 @@ def test_train_mnist_example_with_resume(tmp_path):
     assert "val_accuracy" in out2
 
 
+@pytest.mark.slow
 def test_train_mnist_gpu_example(tmp_path):
     out = run_example(
         "train_mnist_gpu.py", "-b", "100", "-e", "1", "-u", "32",
@@ -110,6 +114,7 @@ def test_single_device_example_tiny(tmp_path):
     assert (tmp_path / "out" / "pyramidnet_final.msgpack").exists()
 
 
+@pytest.mark.slow
 def test_mxnet_kvstore_example(tmp_path):
     """MXNet-idiom Module.fit over a dist_sync KVStore (4 fake devices)."""
     out = run_example(
@@ -122,6 +127,7 @@ def test_mxnet_kvstore_example(tmp_path):
     assert (tmp_path / "o" / "mxnet_cnn.msgpack").exists()
 
 
+@pytest.mark.slow
 def test_train_lm_example(tmp_path):
     """DP causal-LM training decreases loss on the Markov synthetic task."""
     out = run_example(
@@ -134,6 +140,7 @@ def test_train_lm_example(tmp_path):
     assert (tmp_path / "out" / "lm_final.msgpack").exists()
 
 
+@pytest.mark.slow
 def test_train_lm_4d_example(tmp_path):
     """Full dp/sp/pp/tp+ep step over a 1,2,2,1 mesh (4 fake devices)."""
     out = run_example(
@@ -144,6 +151,7 @@ def test_train_lm_4d_example(tmp_path):
     assert float(m.group(1)) < 10.0
 
 
+@pytest.mark.slow
 def test_caffe_train_example(tmp_path):
     out = run_example(
         "caffe_train.py", "--solver", "caffe/lenet_solver.prototxt",
@@ -155,6 +163,7 @@ def test_caffe_train_example(tmp_path):
     assert float(m.group(1)) > 0.5
 
 
+@pytest.mark.slow
 def test_tf_estimator_example(tmp_path):
     out = run_example(
         "tf_estimator.py", "--train_steps", "40",
@@ -167,6 +176,7 @@ def test_tf_estimator_example(tmp_path):
     assert m and float(m.group(1)) > 0.5, out
 
 
+@pytest.mark.slow
 def test_imagenet_resnet50_example(tmp_path):
     out = run_example(
         "imagenet_resnet50.py", "--steps", "6", "--batch-size", "8",
@@ -178,6 +188,7 @@ def test_imagenet_resnet50_example(tmp_path):
     assert re.search(r"step 6/6", out), out
 
 
+@pytest.mark.slow
 def test_ddp_example_native_loader(tmp_path):
     """--num-workers routes the train pipeline through the native C++
     loader (falls back to Python transparently when unbuildable)."""

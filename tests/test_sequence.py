@@ -11,7 +11,9 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtdl_tpu.ops.attention import mha_reference
-from dtdl_tpu.parallel.sequence import ring_attention, ulysses_attention
+from dtdl_tpu.parallel.sequence import (
+    ring_attention, ulysses_attention, zigzag_inverse, zigzag_order,
+)
 
 
 def _seq_mesh(devices, n=4):
@@ -23,42 +25,75 @@ def _rand(shape, seed):
                        jnp.float32)
 
 
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_attention_matches_dense(devices, causal):
+def test_ring_attention_matches_dense(devices, causal, layout):
     mesh = _seq_mesh(devices)
     B, H, S, D = 2, 4, 64, 16
     q, k, v = (_rand((B, H, S, D), s) for s in range(3))
 
     fn = jax.jit(jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
-                                       causal=causal),
+                                       causal=causal, layout=layout),
         mesh=mesh,
         in_specs=(P(None, None, "seq"),) * 3,
         out_specs=P(None, None, "seq")))
-    out = fn(q, k, v)
+    if layout == "zigzag":
+        order, inv = zigzag_order(4, S), zigzag_inverse(4, S)
+        out = fn(q[:, :, order], k[:, :, order], v[:, :, order])[:, :, inv]
+    else:
+        out = fn(q, k, v)
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
 
 
-def test_ring_attention_grads_match_dense(devices):
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_ring_attention_grads_match_dense(devices, layout):
     mesh = _seq_mesh(devices)
     B, H, S, D = 1, 2, 32, 8
     q, k, v = (_rand((B, H, S, D), s) for s in range(3))
+    order = zigzag_order(4, S) if layout == "zigzag" else np.arange(S)
+    inv = np.argsort(order)
 
     ring = jax.shard_map(
-        lambda q, k, v: ring_attention(q, k, v, axis_name="seq", causal=True),
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq", causal=True,
+                                       layout=layout),
         mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
         out_specs=P(None, None, "seq"))
 
-    g_ring = jax.jit(jax.grad(
-        lambda q, k, v: jnp.sum(ring(q, k, v) ** 2), (0, 1, 2)))(q, k, v)
+    def ring_loss(q, k, v):
+        out = ring(q[:, :, order], k[:, :, order], v[:, :, order])[:, :, inv]
+        return jnp.sum(out ** 2)
+
+    g_ring = jax.jit(jax.grad(ring_loss, (0, 1, 2)))(q, k, v)
     g_ref = jax.grad(
         lambda q, k, v: jnp.sum(mha_reference(q, k, v, causal=True) ** 2),
         (0, 1, 2))(q, k, v)
     for a, b, n in zip(g_ring, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-5, rtol=1e-4, err_msg=f"d{n}")
+
+
+def test_zigzag_order_roundtrip():
+    for n, s in [(1, 8), (2, 8), (4, 64), (8, 64)]:
+        order = zigzag_order(n, s)
+        assert sorted(order.tolist()) == list(range(s))
+        np.testing.assert_array_equal(order[zigzag_inverse(n, s)],
+                                      np.arange(s))
+    with pytest.raises(ValueError):
+        zigzag_order(4, 12)                     # not divisible by 2n
+
+
+def test_zigzag_shard_chunks():
+    """Shard i of the zigzag layout holds chunks (i, 2n-1-i)."""
+    n, s = 4, 64
+    c = s // (2 * n)
+    order = zigzag_order(n, s).reshape(n, 2 * c)
+    for i in range(n):
+        np.testing.assert_array_equal(order[i, :c], np.arange(i * c, (i + 1) * c))
+        j = 2 * n - 1 - i
+        np.testing.assert_array_equal(order[i, c:], np.arange(j * c, (j + 1) * c))
 
 
 @pytest.mark.parametrize("causal", [True, False])
