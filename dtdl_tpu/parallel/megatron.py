@@ -16,9 +16,11 @@ explicit so they can be audited and scheduled:
   (dtdl_tpu/parallel/sequence.py) — one ICI hop per step, half a block of
   matmul per device per step.
 * **pp**  — layers stacked ``[n_stages, layers_per_stage, ...]`` and sharded
-  over 'pipe'; a GPipe microbatch schedule runs as a ``lax.scan`` over
-  ticks with a ``ppermute`` stage-to-stage handoff.  Autodiff through the
-  scan+ppermute yields the reverse-schedule backward automatically.
+  over 'pipe'.  Default schedule is **1F1B** (`_value_and_grad_1f1b`): an
+  explicit forward+backward pipeline in one ``lax.scan``, remat per stage,
+  vocab-parallel loss head used only on the last stage, activations capped
+  at ``min(M, 2S-1)`` microbatch inputs.  ``schedule='gpipe'`` keeps the
+  autodiff-through-scan GPipe schedule (`_loss_fn`).
 * **tp**  — Megatron column→row parallel attention/MLP over 'model':
   QKV/up projections column-sharded, out/down projections row-sharded, one
   ``psum`` after attention-out and one after MLP-down per block.
@@ -65,6 +67,7 @@ class MegatronConfig:
     n_experts: int = 0            # 0 = dense MLP; else experts over 'model'
     max_seq: int = 128
     n_microbatches: int = 2
+    schedule: str = "1f1b"        # '1f1b' (default) or 'gpipe'
     dtype: jnp.dtype = jnp.bfloat16
 
     @property
@@ -343,6 +346,205 @@ def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
 
 
 # ---------------------------------------------------------------------------
+# the 1F1B schedule (explicit-VJP pipeline, inside shard_map)
+# ---------------------------------------------------------------------------
+
+def bubble_fraction(cfg: MegatronConfig) -> float:
+    """Idle fraction of the 1F1B schedule: 2(S-1) of M+2(S-1) ticks.
+
+    Each tick carries one forward and one backward lane; a stage is idle in a
+    lane for (S-1) warmup + (S-1) cooldown ticks.  GPipe has the same bubble
+    — 1F1B's win is peak memory: at most ``min(M, 2S-1)`` in-flight
+    microbatch activations per stage instead of all M (plus, here, the loss
+    head's full output never being broadcast across stages).
+    """
+    s, m = cfg.n_stages, cfg.n_microbatches
+    return 2 * (s - 1) / (m + 2 * (s - 1))
+
+
+def _vary(x, axes):
+    """pcast ``x`` to additionally vary over ``axes`` (no-op where it does)."""
+    have = jax.typeof(x).vma or ()
+    add = tuple(a for a in axes if a not in have)
+    return lax.pcast(x, add, to="varying") if add else x
+
+
+def _head_loss(cfg, emb, ln_f, y, targets, mask, inv_total):
+    """Vocab-parallel LM head: scaled loss-sum of one microbatch.
+
+    The vocab dim is sharded over 'model' (Megatron-style vocab-parallel
+    cross entropy): each tp shard computes logits for its V/tp slice, the
+    logsumexp and true-logit gather are combined with one scalar-per-token
+    psum('model') each — the full [.., V] logits never materialize per
+    device when tp > 1.
+    """
+    v = cfg.vocab_size
+    tp = lax.axis_size(MODEL)
+    h = _rms(y, ln_f).astype(jnp.float32)
+    if tp > 1 and v % tp == 0:
+        v_loc = v // tp
+        off = lax.axis_index(MODEL) * v_loc
+        emb_slice = lax.dynamic_slice_in_dim(emb, off, v_loc, 0)
+        logits = jnp.einsum("bsd,vd->bsv", h, emb_slice.astype(jnp.float32))
+        mx = lax.pmax(lax.stop_gradient(jnp.max(logits, -1)), MODEL)
+        se = lax.psum(jnp.sum(jnp.exp(logits - mx[..., None]), -1), MODEL)
+        lse = mx + jnp.log(se)
+        in_range = (targets >= off) & (targets < off + v_loc)
+        idx = jnp.clip(targets - off, 0, v_loc - 1)
+        true_logit = lax.psum(
+            jnp.where(in_range,
+                      jnp.take_along_axis(logits, idx[..., None], -1)[..., 0],
+                      0.0), MODEL)
+    else:
+        logits = jnp.einsum("bsd,vd->bsv", h, emb.astype(jnp.float32))
+        lse = jax.nn.logsumexp(logits, -1)
+        true_logit = jnp.take_along_axis(
+            logits, targets[..., None], -1)[..., 0]
+    loss = jnp.sum((lse - true_logit) * mask) * inv_total
+    if MODEL in (jax.typeof(loss).vma or ()):
+        # replicated-head branch: every tp shard computed the same value;
+        # pmean is a value-preserving demotion to MODEL-unvarying, keeping
+        # the scan carry types identical across both branches
+        loss = lax.pmean(loss, MODEL)
+    return loss
+
+
+def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
+    """(loss, grads) via an explicit 1F1B pipeline schedule.  Inside shard_map.
+
+    One ``lax.scan`` over ``M + 2(S-1)`` ticks.  Per tick, every stage runs
+    one forward (microbatch ``t - stage``) *and* one backward (microbatch
+    ``t - 2(S-1) + stage``, rematerialized ``jax.vjp`` of the stage), so the
+    last stage backprops a microbatch the same tick it finishes its forward
+    — the 1F1B steady state.  Two ``ppermute``s per tick move activations up
+    and gradients down the 'pipe' ring.  Input embeddings are looked up (and
+    their cotangent scatter-added) per microbatch inside the tick, so no
+    O(M) activation or cotangent buffer exists anywhere.  Compared with
+    autodiff through the GPipe scan (`_loss_fn`), this (a) caps live
+    activations at ``min(M, 2S-1)`` stage *inputs* (remat recomputes the
+    rest), (b) never
+    psum-broadcasts stage outputs — only the last stage's head result is
+    used, and only scalar loss + per-microbatch dy leave it (the redundancy
+    the round-1 review flagged), and (c) shards the head's vocab dim over
+    'model'.  SPMD lockstep means every device still *executes* the head
+    each tick (results masked off-stage) — the schedule trades that
+    arithmetic for never materializing or broadcasting cross-stage state.
+
+    Replaces ``jax.value_and_grad(_loss_fn)``; gradient reductions that fell
+    out of VMA-typed autodiff there are explicit here: stage/embed/ln_f
+    cotangents are accumulated locally (params pcast varying) and psummed
+    once after the scan.
+    """
+    S, M = cfg.n_stages, cfg.n_microbatches
+    b_loc, s_loc = tokens.shape
+    mb = b_loc // M
+    D = cfg.d_model
+    stage = lax.axis_index(PIPE)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq)
+
+    inv_total = 1.0 / jnp.maximum(
+        lax.psum(jnp.sum(mask), (DATA, SEQ)), 1.0)
+    tok_micro = _vary(tokens.reshape(M, mb, s_loc), (PIPE,))
+    tgt_micro = _vary(targets.reshape(M, mb, s_loc), (PIPE,))
+    msk_micro = _vary(mask.reshape(M, mb, s_loc), (PIPE,))
+
+    # localized (per-device cotangent) copies of everything we differentiate
+    p_stage = jax.tree.map(lambda a: _vary(a[0], (DATA, SEQ)),
+                           params["blocks"])
+    emb_v = _vary(params["embed"], (DATA, SEQ, PIPE, MODEL))
+    emb_in_v = _vary(params["embed"], (DATA, SEQ, PIPE))
+    lnf_v = _vary(params["ln_f"], (DATA, SEQ, PIPE))
+
+    def stage_fn(p, x):
+        return _stage_forward(cfg, p, x, cos, sin)
+
+    perm_up = [(i, (i + 1) % S) for i in range(S)]
+    perm_down = [(i, (i - 1) % S) for i in range(S)]
+    n_slots = min(M, 2 * S - 1)
+    n_ticks = M + 2 * (S - 1)
+
+    act_axes = tuple(sorted(set(jax.typeof(tok_micro).vma or ())))
+    zeros_act = lambda shape: _vary(jnp.zeros(shape, cfg.dtype), act_axes)
+    carry0 = dict(
+        buf_f=zeros_act((mb, s_loc, D)),
+        buf_b=zeros_act((mb, s_loc, D)),
+        x_saved=zeros_act((n_slots, mb, s_loc, D)),
+        dw=jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), p_stage),
+        demb=jnp.zeros_like(emb_v, jnp.float32),
+        demb_in=jnp.zeros_like(emb_in_v, jnp.float32),
+        dlnf=jnp.zeros_like(lnf_v, jnp.float32),
+        loss=_vary(jnp.zeros((), jnp.float32), act_axes),
+    )
+
+    def tick(carry, t):
+        # ---- forward lane: microbatch m_f enters this stage -------------
+        m_f = t - stage
+        f_active = (m_f >= 0) & (m_f < M)
+        m_idx = jnp.clip(m_f, 0, M - 1)
+        tok_f = lax.dynamic_index_in_dim(tok_micro, m_idx, 0, keepdims=False)
+        inject = jnp.take(params["embed"], tok_f, axis=0).astype(cfg.dtype)
+        x_in = jnp.where(stage == 0, inject, carry["buf_f"])
+        slot_f = jnp.mod(m_idx, n_slots)
+        old = lax.dynamic_index_in_dim(carry["x_saved"], slot_f, 0,
+                                       keepdims=False)
+        x_saved = lax.dynamic_update_index_in_dim(
+            carry["x_saved"], jnp.where(f_active, x_in, old), slot_f, 0)
+        y = stage_fn(p_stage, x_in)
+
+        # ---- head on the forward output (used on the last stage only) --
+        tgt = lax.dynamic_index_in_dim(tgt_micro, m_idx, 0, keepdims=False)
+        msk = lax.dynamic_index_in_dim(msk_micro, m_idx, 0, keepdims=False)
+        loss_m, head_vjp = jax.vjp(
+            lambda e, lf, yy: _head_loss(cfg, e, lf, yy, tgt, msk, inv_total),
+            emb_v, lnf_v, y)
+        demb_m, dlnf_m, dy_head = head_vjp(
+            _vary(jnp.float32(1.0), jax.typeof(loss_m).vma or ()))
+        head_active = (stage == S - 1) & f_active
+        loss = carry["loss"] + jnp.where(head_active, loss_m, 0.0)
+        demb = carry["demb"] + jnp.where(head_active, demb_m, 0.0)
+        dlnf = carry["dlnf"] + jnp.where(head_active, dlnf_m, 0.0)
+
+        # ---- backward lane: microbatch u_b leaves this stage ------------
+        u_b = t - 2 * (S - 1) + stage
+        b_active = (u_b >= 0) & (u_b < M)
+        u_idx = jnp.clip(u_b, 0, M - 1)
+        x_b = lax.dynamic_index_in_dim(x_saved, jnp.mod(u_idx, n_slots), 0,
+                                       keepdims=False)
+        dy = jnp.where(stage == S - 1, dy_head, carry["buf_b"])
+        _, stage_vjp = jax.vjp(stage_fn, p_stage, x_b)
+        dw_m, dx = stage_vjp(dy)
+        dw = jax.tree.map(
+            lambda a, d: a + jnp.where(b_active, d, 0.0), carry["dw"], dw_m)
+        # embedding cotangent of this microbatch (scatter-add), stage 0 only
+        tok_b = lax.dynamic_index_in_dim(tok_micro, u_idx, 0, keepdims=False)
+        _, embed_vjp = jax.vjp(
+            lambda e: jnp.take(e, tok_b, axis=0).astype(cfg.dtype), emb_in_v)
+        (demb_u,) = embed_vjp(dx)
+        demb_in = carry["demb_in"] + jnp.where(
+            b_active & (stage == 0), demb_u, 0.0)
+
+        # ---- ring handoffs ---------------------------------------------
+        new_carry = dict(
+            buf_f=lax.ppermute(y, PIPE, perm_up),
+            buf_b=lax.ppermute(dx, PIPE, perm_down),
+            x_saved=x_saved, dw=dw, demb=demb, demb_in=demb_in,
+            dlnf=dlnf, loss=loss)
+        return new_carry, None
+
+    carry, _ = lax.scan(tick, carry0, jnp.arange(n_ticks))
+
+    # ---- combine cotangents into global-layout grads ---------------------
+    demb = (lax.psum(carry["demb"], (DATA, SEQ, PIPE, MODEL))
+            + lax.psum(carry["demb_in"], (DATA, SEQ, PIPE)))
+    dlnf = lax.psum(carry["dlnf"], (DATA, SEQ, PIPE))
+    dblocks = jax.tree.map(lambda a: lax.psum(a, (DATA, SEQ))[None],
+                           carry["dw"])
+    loss = lax.psum(carry["loss"], (DATA, SEQ, PIPE))
+    grads = {"embed": demb, "ln_f": dlnf, "blocks": dblocks}
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
@@ -375,9 +577,16 @@ def make_megatron_train_step(cfg: MegatronConfig, mesh: Mesh, optimizer):
     specs = param_specs(cfg)
     o_specs = opt_state_specs(cfg, optimizer)
 
+    if cfg.schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown pipeline schedule {cfg.schedule!r}")
+
     def step(params, opt_state, tokens, targets, mask):
-        loss, grads = jax.value_and_grad(
-            partial(_loss_fn, cfg))(params, tokens, targets, mask)
+        if cfg.schedule == "1f1b":
+            loss, grads = _value_and_grad_1f1b(cfg, params, tokens,
+                                               targets, mask)
+        else:
+            loss, grads = jax.value_and_grad(
+                partial(_loss_fn, cfg))(params, tokens, targets, mask)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss

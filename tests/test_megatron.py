@@ -90,9 +90,11 @@ def oracle_loss(cfg, params, tokens, targets, mask):
 
 # ---- tests -----------------------------------------------------------------
 
-@pytest.mark.parametrize("n_experts", [0, 4])
-def test_4d_step_matches_oracle(devices, n_experts):
-    cfg = _cfg(n_experts=n_experts)
+@pytest.mark.parametrize("n_experts,schedule", [
+    (0, "1f1b"), (4, "1f1b"), (0, "gpipe"), (4, "gpipe"),
+])
+def test_4d_step_matches_oracle(devices, n_experts, schedule):
+    cfg = _cfg(n_experts=n_experts, schedule=schedule)
     mesh = M.build_4d_mesh(devices)
     assert dict(mesh.shape) == {"data": 1, "seq": 2, "pipe": 2, "model": 2}
 
@@ -140,6 +142,64 @@ def test_4d_step_loss_decreases(devices):
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
     assert all(np.isfinite(losses)), losses
+
+
+def test_1f1b_more_microbatches_than_slots(devices):
+    """M > 2S-1 exercises the ring reuse of the saved-activation slots."""
+    cfg = _cfg(n_microbatches=8)
+    mesh = M.build_4d_mesh(devices)
+    batch_host = _batch(cfg, B=8, S=32, seed=2)
+    params_host = jax.device_get(M.init_params(cfg, jax.random.PRNGKey(3)))
+    loss_ref, grads_ref = jax.value_and_grad(
+        lambda p: oracle_loss(cfg, p, jnp.asarray(batch_host["tokens"]),
+                              jnp.asarray(batch_host["targets"]),
+                              jnp.asarray(batch_host["mask"])))(params_host)
+    params_ref = jax.tree.map(lambda p, g: p - 0.1 * g,
+                              params_host, grads_ref)
+
+    opt = optax.sgd(0.1)
+    params = M.place_params(mesh, cfg, params_host)
+    opt_state = M.init_optimizer(cfg, mesh, opt, params)
+    step = M.make_megatron_train_step(cfg, mesh, opt)
+    batch = M.shard_lm_batch(mesh, batch_host)
+    params, opt_state, loss = step(params, opt_state, batch["tokens"],
+                                   batch["targets"], batch["mask"])
+    np.testing.assert_allclose(float(loss), float(loss_ref),
+                               atol=1e-5, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(jax.device_get(params)),
+                    jax.tree.leaves(params_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_1f1b_single_device_mesh(devices):
+    """tp=1 takes the replicated-head branch; S=1 degenerates the ring."""
+    cfg = _cfg(n_stages=1, n_microbatches=4)
+    mesh = M.build_4d_mesh(devices[:1])
+    batch_host = _batch(cfg, B=8, S=32, seed=4)
+    params_host = jax.device_get(M.init_params(cfg, jax.random.PRNGKey(5)))
+    loss_ref = oracle_loss(cfg, params_host,
+                           jnp.asarray(batch_host["tokens"]),
+                           jnp.asarray(batch_host["targets"]),
+                           jnp.asarray(batch_host["mask"]))
+    opt = optax.sgd(0.1)
+    params = M.place_params(mesh, cfg, params_host)
+    opt_state = M.init_optimizer(cfg, mesh, opt, params)
+    step = M.make_megatron_train_step(cfg, mesh, opt)
+    batch = M.shard_lm_batch(mesh, batch_host)
+    _, _, loss = step(params, opt_state, batch["tokens"],
+                      batch["targets"], batch["mask"])
+    np.testing.assert_allclose(float(loss), float(loss_ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_bubble_fraction():
+    # GPipe and non-interleaved 1F1B share the bubble formula; 1F1B's win
+    # is peak memory (min(M, 2S-1) live microbatch inputs, not M).
+    assert M.bubble_fraction(_cfg(n_stages=1, n_microbatches=4)) == 0.0
+    assert M.bubble_fraction(_cfg(n_stages=2, n_microbatches=2)) == 0.5
+    assert abs(M.bubble_fraction(_cfg(n_stages=4, n_microbatches=16))
+               - 6 / 22) < 1e-12
 
 
 def test_factor_mesh():
