@@ -24,6 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from dtdl_tpu.utils.prototxt import Message
@@ -67,6 +68,67 @@ def parse_net(msg: Message) -> list[LayerSpec]:
             phases=_phases(layer),
         ))
     return specs
+
+
+_VARIANCE_MODES = {"FAN_IN": "fan_in", "FAN_OUT": "fan_out",
+                   "AVERAGE": "fan_avg"}
+
+
+def _filler_init(param: Message, key: str):
+    """Caffe FillerParameter → flax initializer (or None if absent).
+
+    Caffe seeds every learnable blob from a filler
+    (weight_filler/bias_filler in the layer's param message); ignoring them
+    makes training trajectories diverge from a real Caffe run of the same
+    prototxt.  Types honored: constant, uniform, gaussian, xavier, msra,
+    positive_unitball — with Caffe's defaults (constant 0.0, uniform [0,1),
+    gaussian std 1, variance_norm FAN_IN).
+    """
+    f = param.get_scalar(key, None)
+    if f is None:
+        return None
+    t = str(f.get_scalar("type", "constant"))
+    if t == "constant":
+        return nn.initializers.constant(float(f.get_scalar("value", 0.0)))
+    if t == "uniform":
+        lo = float(f.get_scalar("min", 0.0))
+        hi = float(f.get_scalar("max", 1.0))
+        return lambda k, shape, dtype=jnp.float32: jax.random.uniform(
+            k, shape, dtype, lo, hi)
+    if t == "gaussian":
+        mean = float(f.get_scalar("mean", 0.0))
+        std = float(f.get_scalar("std", 1.0))
+        return lambda k, shape, dtype=jnp.float32: (
+            mean + std * jax.random.normal(k, shape, dtype))
+    mode = _VARIANCE_MODES.get(
+        str(f.get_scalar("variance_norm", "FAN_IN")).upper(), "fan_in")
+    if t == "xavier":
+        # uniform on [-sqrt(3/n), sqrt(3/n)] — variance_scaling's uniform
+        # branch with scale 1 computes exactly that limit
+        return nn.initializers.variance_scaling(1.0, mode, "uniform")
+    if t == "msra":
+        # gaussian with std sqrt(2/n) (He et al.), Caffe uses a plain normal
+        return nn.initializers.variance_scaling(2.0, mode, "normal")
+    if t == "positive_unitball":
+        def init(k, shape, dtype=jnp.float32):
+            x = jax.random.uniform(k, shape, dtype)
+            flat = x.reshape(-1, shape[-1])
+            return (flat / flat.sum(axis=0)).reshape(shape)
+        return init
+    raise NotImplementedError(f"Caffe filler type {t!r}")
+
+
+def _filler_kwargs(param: Message) -> dict:
+    """kernel_init/bias_init kwargs for a layer's fillers (flax defaults
+    stand in when a filler is absent)."""
+    kw = {}
+    w = _filler_init(param, "weight_filler")
+    b = _filler_init(param, "bias_filler")
+    if w is not None:
+        kw["kernel_init"] = w
+    if b is not None:
+        kw["bias_init"] = b
+    return kw
 
 
 def _pair(param: Message, key: str, default=0):
@@ -145,7 +207,7 @@ class CaffeNet(nn.Module):
                 kernel_dilation=(max(dh, 1), max(dw, 1)),
                 feature_group_count=int(p.get_scalar("group", 1)),
                 use_bias=bool(p.get_scalar("bias_term", True)),
-                dtype=self.dtype, name=spec.name)(x)
+                dtype=self.dtype, name=spec.name, **_filler_kwargs(p))(x)
         if t == "Pooling":
             p = spec.params.get_scalar("pooling_param", Message())
             if bool(p.get_scalar("global_pooling", False)):
@@ -194,7 +256,8 @@ class CaffeNet(nn.Module):
                 x = x.reshape((x.shape[0], -1))
             return nn.Dense(int(p.get_scalar("num_output")),
                             use_bias=bool(p.get_scalar("bias_term", True)),
-                            dtype=self.dtype, name=spec.name)(x)
+                            dtype=self.dtype, name=spec.name,
+                            **_filler_kwargs(p))(x)
         if t == "ReLU":
             # Caffe ReLU supports leaky slope via negative_slope
             p = spec.params.get_scalar("relu_param", Message())

@@ -382,6 +382,21 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _legal_block(seq: int, block: int) -> int:
+    """Normalize a block size to Mosaic-legal tiling geometry.
+
+    A block's seq dims must be 128-multiples or span the whole array dim:
+    whole-seq when the seq fits in one block (or the 128 floor), else the
+    largest 128-multiple <= the request.  Applied **unconditionally** — the
+    interpreter (CPU test) path runs the exact tiling geometry the TPU path
+    compiles, so CPU green means the TPU grid shape was exercised.
+    """
+    if seq <= block:
+        return seq
+    b = max(128, block // 128 * 128)
+    return seq if seq <= b else b
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
                     block_q: int = 512, block_k: int = 512):
@@ -402,12 +417,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
     sk = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if not _use_interpret():
-        # Mosaic tiling: a block's trailing dims must be (8,128)-multiples or
-        # span the whole array dim; normalize block sizes so any seq length
-        # lowers (whole-seq block below 128, 128-multiples above).
-        block_q = sq if sq <= block_q else max(128, block_q // 128 * 128)
-        block_k = sk if sk <= block_k else max(128, block_k // 128 * 128)
+    block_q = _legal_block(sq, block_q)
+    block_k = _legal_block(sk, block_k)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)

@@ -74,9 +74,20 @@ def hybrid_mesh(ici_shape: tuple[int, ...] | None = None,
     """
     if devices is None:
         devices = jax.devices()
-    slice_ids = sorted({getattr(d, "slice_index", None) for d in devices},
-                       key=lambda s: (s is None, s))
-    detected = len(slice_ids) > 1 and slice_ids[0] is not None
+    per_dev = [getattr(d, "slice_index", None) for d in devices]
+    with_idx = [d for d, s in zip(devices, per_dev) if s is not None]
+    if with_idx and len(with_idx) != len(devices):
+        missing = [d for d, s in zip(devices, per_dev) if s is None]
+        raise ValueError(
+            f"mixed slice metadata: {len(with_idx)} device(s) report a "
+            f"slice_index but {len(missing)} do(es) not (e.g. "
+            f"{missing[0]!r}). A mesh cannot mix slice-aware and "
+            f"slice-less devices — pass an explicit homogeneous `devices` "
+            f"list, or `num_slices` with devices that all lack slice_index.")
+    slice_ids = sorted({s for s in per_dev if s is not None})
+    if not slice_ids:
+        slice_ids = [None]
+    detected = len(slice_ids) > 1
     if detected and num_slices is not None and num_slices != len(slice_ids):
         raise ValueError(
             f"num_slices={num_slices} conflicts with the platform's "

@@ -32,7 +32,7 @@ import numpy as np
 import optax
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer
-from dtdl_tpu.data.loader import LimitBatches, prefetch_to_device
+from dtdl_tpu.data.loader import LimitBatches, prefetch_to_device, resume_iter
 from dtdl_tpu.metrics.report import Reporter, StdoutSink
 from dtdl_tpu.train.loop import evaluate as _evaluate
 from dtdl_tpu.models.netspec import build_net
@@ -208,6 +208,14 @@ class Solver:
         backward passes followed by ONE parameter update (the optimizer is
         an optax.MultiSteps when iter_size > 1), so max_iter counts updates
         and consumes max_iter * iter_size batches.
+
+        Resume is replay-exact: the batch stream is a deterministic function
+        of the batch counter (pass index = batches // len(loader) keys the
+        shuffle, offset = batches % len(loader) is skipped at the index
+        level via ``resume_iter``), and snapshots land on update boundaries,
+        so restore() + solve() replays the identical remaining stream an
+        uninterrupted run would have seen — the same contract Trainer and
+        Estimator resume have.
         """
         sp = self.param
         display = int(sp.get_scalar("display", 0))
@@ -219,17 +227,30 @@ class Solver:
             self.reporter.report({"iter": self.iteration, **self.test()})
         last: dict = {}
         metrics = None
-        micro = 0
+        try:
+            steps_per_pass = len(self.train_loader)
+        except TypeError:
+            # unsized (generator-style) loader: replay-exact resume isn't
+            # possible — keep the legacy per-pass keying, resume restarts
+            # the interrupted pass at its head
+            steps_per_pass = None
+        # snapshots only happen on iteration (= update) boundaries, so the
+        # restored stream position is exactly iteration * iter_size batches
+        batches = self.iteration * iter_size
         while self.iteration < self.max_iter:
-            self.train_loader.set_epoch(self.iteration)
-            it = prefetch_to_device(iter(self.train_loader),
+            if steps_per_pass:
+                pass_idx, skip = divmod(batches, steps_per_pass)
+            else:
+                pass_idx, skip = self.iteration, 0
+            self.train_loader.set_epoch(pass_idx)
+            it = prefetch_to_device(resume_iter(self.train_loader, skip),
                                     self.strategy.shard_batch, 2)
             for batch in it:
                 if self.iteration >= self.max_iter:
                     break
                 self.state, metrics = self.train_step(self.state, batch)
-                micro += 1
-                if micro % iter_size:
+                batches += 1
+                if batches % iter_size:
                     continue  # mid-accumulation: not an iteration yet
                 self.iteration += 1
                 if display and self.iteration % display == 0:

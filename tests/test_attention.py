@@ -14,23 +14,37 @@ def _rand(shape, seed=0):
                        jnp.float32)
 
 
+def test_legal_block_geometry():
+    """Blocks normalize to Mosaic-legal sizes identically on CPU and TPU:
+    whole-seq when it fits (or under the 128 floor), else 128-multiples."""
+    from dtdl_tpu.ops.attention import _legal_block
+    assert _legal_block(96, 32) == 96      # sub-floor seq: one whole block
+    assert _legal_block(96, 512) == 96     # seq fits the block
+    assert _legal_block(200, 128) == 128   # ragged tail tile
+    assert _legal_block(640, 512) == 512
+    assert _legal_block(200, 150) == 128   # rounds down to the 128 grid
+    assert _legal_block(1024, 512) == 512
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_forward_matches_dense(causal):
-    q, k, v = (_rand((2, 3, 96, 32), s) for s in range(3))
-    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    # seq 256 with 128-blocks: a real 2x2 multi-block grid (the normalized
+    # geometry — sub-128 blocks round up to whole-seq, see _legal_block)
+    q, k, v = (_rand((2, 2, 256, 32), s) for s in range(3))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-6, rtol=1e-5)
 
 
 def test_flash_grads_match_dense():
-    q, k, v = (_rand((1, 2, 64, 16), s) for s in range(3))
+    q, k, v = (_rand((1, 1, 256, 16), s) for s in range(3))
 
     def loss(fn):
         return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
 
     g_flash = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, block_q=32, block_k=32)), (0, 1, 2))(q, k, v)
+        q, k, v, causal=True, block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss(lambda q, k, v: mha_reference(
         q, k, v, causal=True)), (0, 1, 2))(q, k, v)
     for a, b in zip(g_flash, g_ref):
@@ -40,11 +54,12 @@ def test_flash_grads_match_dense():
 
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_cross_attention(causal):
-    """q shorter than k/v; causal must be bottom-aligned like the oracle."""
-    q = _rand((2, 2, 32, 16), 0)
-    k = _rand((2, 2, 64, 16), 1)
-    v = _rand((2, 2, 64, 16), 2)
-    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    """q shorter than k/v; causal must be bottom-aligned like the oracle.
+    q gets a ragged 128+32 grid, k/v a ragged 2.5-block grid."""
+    q = _rand((2, 2, 160, 16), 0)
+    k = _rand((2, 2, 320, 16), 1)
+    v = _rand((2, 2, 320, 16), 2)
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
     assert out.shape == q.shape
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -54,7 +69,7 @@ def test_flash_cross_attention(causal):
         return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
 
     g = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=causal, block_q=16, block_k=16)), (0, 1, 2))(q, k, v)
+        q, k, v, causal=causal, block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss(lambda q, k, v: mha_reference(
         q, k, v, causal=causal)), (0, 1, 2))(q, k, v)
     for a, b in zip(g, g_ref):
@@ -65,8 +80,8 @@ def test_flash_cross_attention(causal):
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_ragged_blocks(causal):
     # seq not a multiple of the block size exercises padded edge tiles
-    q, k, v = (_rand((1, 1, 80, 32), s) for s in range(3))
-    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    q, k, v = (_rand((1, 1, 200, 32), s) for s in range(3))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-6, rtol=1e-5)
@@ -75,7 +90,7 @@ def test_flash_ragged_blocks(causal):
         return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
 
     g = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=causal, block_q=32, block_k=32)), (0, 1, 2))(q, k, v)
+        q, k, v, causal=causal, block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss(lambda q, k, v: mha_reference(
         q, k, v, causal=causal)), (0, 1, 2))(q, k, v)
     for a, b in zip(g, g_ref):
@@ -87,9 +102,9 @@ def test_flash_bf16_forward_and_grads():
     """bf16 inputs exercise the native-dtype matmul paths (the astype calls
     at every dot site are no-ops under f32); f32 reference with loose
     tolerance bounds the bf16 rounding."""
-    q, k, v = (_rand((2, 2, 64, 32), s).astype(jnp.bfloat16)
+    q, k, v = (_rand((2, 2, 256, 32), s).astype(jnp.bfloat16)
                for s in range(3))
-    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     assert out.dtype == jnp.bfloat16
     ref = mha_reference(q.astype(jnp.float32), k.astype(jnp.float32),
                         v.astype(jnp.float32), causal=True)
@@ -101,7 +116,7 @@ def test_flash_bf16_forward_and_grads():
             fn(cast(q), cast(k), cast(v)).astype(jnp.float32) ** 2)
 
     g = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, block_q=32, block_k=32), lambda x: x),
+        q, k, v, causal=True, block_q=128, block_k=128), lambda x: x),
         (0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss(lambda q, k, v: mha_reference(q, k, v, causal=True),
                           lambda x: x.astype(jnp.float32)), (0, 1, 2))(

@@ -419,3 +419,90 @@ def test_solver_resume_at_max_iter_is_noop(tmp_path, devices):
     assert s2.restore()
     assert s2.iteration == 6
     assert s2.solve() == {}  # nothing left to do; no crash
+
+
+def test_solver_midrun_resume_replay_exact(tmp_path, devices):
+    """Stop at a mid-pass snapshot, resume in a fresh process-equivalent
+    solver, and land bit-identical to an uninterrupted run: the batch
+    stream is a pure function of the batch counter (pass index keys the
+    shuffle, offset skipped at the index level)."""
+    out_a, out_b = str(tmp_path / "a"), str(tmp_path / "b")
+    # steps_per_pass = 512/64 = 8; max_iter 11 crosses a pass boundary and
+    # the snapshot at 5 is mid-pass
+    train, test = _loaders()
+    ref = Solver(_solver_files(tmp_path, max_iter=11),
+                 train, test, strategy=SingleDevice(), out=out_a)
+    ref.solve()
+
+    train2, test2 = _loaders()
+    s1 = Solver(_solver_files(tmp_path, max_iter=5, extra="snapshot: 5"),
+                train2, test2, strategy=SingleDevice(), out=out_b)
+    s1.solve()
+    train3, test3 = _loaders()
+    s2 = Solver(_solver_files(tmp_path, max_iter=11),
+                train3, test3, strategy=SingleDevice(), out=out_b)
+    assert s2.restore()
+    assert s2.iteration == 5
+    s2.solve()
+    assert s2.iteration == 11
+    for a, b in zip(jax.tree.leaves(ref.state.params),
+                    jax.tree.leaves(s2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- fillers ----------------------------------------------------------------
+
+def test_fillers_constant_gaussian_xavier():
+    """weight_filler/bias_filler map to flax initializers (Caffe semantics:
+    constant value, gaussian mean/std, xavier uniform bound sqrt(3/fan_in))."""
+    net = build_net('''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+              inner_product_param {
+                num_output: 300
+                weight_filler { type: "gaussian" mean: 0.5 std: 0.01 }
+                bias_filler { type: "constant" value: 0.25 } } }
+      layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+              inner_product_param {
+                num_output: 40
+                weight_filler { type: "xavier" } } }
+    ''')
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 200)))
+    p = variables["params"]
+    w1, b1 = np.asarray(p["ip1"]["kernel"]), np.asarray(p["ip1"]["bias"])
+    np.testing.assert_array_equal(b1, np.full_like(b1, 0.25))
+    assert abs(w1.mean() - 0.5) < 0.005
+    assert abs(w1.std() - 0.01) < 0.005
+    w2 = np.asarray(p["ip2"]["kernel"])
+    bound = np.sqrt(3.0 / 300)
+    assert np.abs(w2).max() <= bound + 1e-6
+    assert np.abs(w2).max() > 0.8 * bound  # actually uniform, not zeros
+
+
+def test_fillers_uniform_msra_and_conv():
+    net = build_net('''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+              convolution_param {
+                num_output: 64 kernel_size: 3
+                weight_filler { type: "msra" }
+                bias_filler { type: "uniform" min: -0.5 max: -0.25 } } }
+    ''')
+    variables = net.init(jax.random.PRNGKey(1), jnp.zeros((1, 8, 8, 16)))
+    p = variables["params"]["c1"]
+    w, b = np.asarray(p["kernel"]), np.asarray(p["bias"])
+    assert (b >= -0.5).all() and (b <= -0.25).all()
+    fan_in = 3 * 3 * 16
+    assert abs(w.std() - np.sqrt(2.0 / fan_in)) < 0.02
+
+
+def test_filler_unknown_type_raises():
+    net = build_net('''
+      layer { name: "d" type: "Input" top: "data" }
+      layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+              inner_product_param {
+                num_output: 4
+                weight_filler { type: "bilinear" } } }
+    ''')
+    with pytest.raises(NotImplementedError, match="filler"):
+        net.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))

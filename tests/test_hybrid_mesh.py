@@ -97,3 +97,15 @@ def test_hierarchical_dropout_rank_fold(devices):
     shards = [np.asarray(s.data) for s in leaf.addressable_shards]
     for s in shards[1:]:
         np.testing.assert_array_equal(shards[0], s)
+
+
+def test_hybrid_mesh_mixed_slice_metadata_diagnostic(devices):
+    """Some devices reporting slice_index and some not must fail with a
+    clear 'mixed slice metadata' error, not an unequal-slice-size puzzle."""
+    from types import SimpleNamespace
+
+    with_idx = [SimpleNamespace(slice_index=0), SimpleNamespace(slice_index=0),
+                SimpleNamespace(slice_index=1)]
+    without = [SimpleNamespace()]
+    with pytest.raises(ValueError, match="mixed slice metadata"):
+        hybrid_mesh(devices=with_idx + without)
