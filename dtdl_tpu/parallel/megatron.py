@@ -25,9 +25,11 @@ explicit so they can be audited and scheduled:
   QKV/up projections column-sharded, out/down projections row-sharded, one
   ``psum`` after attention-out and one after MLP-down per block.
 * **ep**  — MoE experts sharded over 'model' (expert-parallel on the tensor
-  axis): tokens are masked to their expert via one-hot dense dispatch, each
-  device computes its local experts, and the same row-parallel ``psum``
-  combines expert outputs — no extra collective beyond TP's.
+  axis).  Default dispatch is **routed**: capacity-factor top-1 routing with
+  a token ``lax.all_to_all`` over 'model' to the expert's owner and back
+  (dispatch FLOPs linear in tokens; dropped-token fraction reported in the
+  step metrics).  ``moe_dispatch='dense'`` keeps the one-hot
+  every-local-expert oracle.
 
 Parameters are a plain pytree whose leaves carry global shapes; shard_map's
 ``in_specs`` (from ``param_specs``) place them.  Everything here is pure
@@ -68,6 +70,9 @@ class MegatronConfig:
     max_seq: int = 128
     n_microbatches: int = 2
     schedule: str = "1f1b"        # '1f1b' (default) or 'gpipe'
+    virtual_stages: int = 1       # v chunks/device: interleaved 1F1B when >1
+    moe_dispatch: str = "routed"  # 'routed' (capacity + all-to-all) | 'dense'
+    capacity_factor: float = 1.25  # per-expert slots = cf * tokens / E
     dtype: jnp.dtype = jnp.bfloat16
 
     @property
@@ -218,8 +223,89 @@ def _mlp_dense(cfg, p, x):
     return lax.psum(jnp.einsum("bsf,fd->bsd", h, wo), MODEL)
 
 
+def _mlp_moe_routed(cfg, p, x):
+    """Capacity-factor top-1 routed MoE: token all-to-all over 'model'.
+
+    Real expert parallelism (the dense one-hot path below is the oracle):
+    dispatch FLOPs are linear in tokens, not tokens x experts.
+
+    Inside shard_map, ``x`` is MODEL-invariant (every tp shard holds the
+    same tokens), so dispatch starts by *partitioning* the token set over
+    'model' — each shard routes its T/tp slice (Megatron sequence-parallel
+    MoE shape).  Per (source shard, expert) capacity ``C = ceil(cf * T_loc
+    / E)`` slots; each shard scatters its kept tokens into an [E, C, D]
+    send buffer (overflow tokens *dropped*, Switch-Transformer semantics),
+    one ``lax.all_to_all`` delivers every expert's tokens to the shard that
+    owns it, the expert FFNs run batched over [e_loc, tp*C, D], and a
+    second all-to-all returns outputs to the token's source shard, where
+    they are gathered back to token order, gated, and psum-restored to the
+    MODEL-invariant layout every block ends with.
+
+    Returns ``(y, (n_dropped, n_tokens))`` — the dropped-token accounting
+    (already psummed over 'model') that the train step reports as
+    ``moe_dropped_frac``.
+    """
+    e_loc = p["wi"].shape[0]                     # local experts (E / tp)
+    tp = lax.axis_size(MODEL)
+    my = lax.axis_index(MODEL)
+    E = e_loc * tp
+    b, s, D = x.shape
+    T = b * s
+    xf = x.reshape(T, D)
+    Tp = -(-T // tp) * tp                        # pad to a tp multiple
+    if Tp != T:
+        xf = jnp.pad(xf, ((0, Tp - T), (0, 0)))
+    T_loc = Tp // tp
+    xs = lax.dynamic_slice_in_dim(xf, my * T_loc, T_loc, 0)  # my slice
+    valid = (my * T_loc + jnp.arange(T_loc)) < T
+
+    logits = jnp.einsum("td,de->te", xs.astype(jnp.float32), p["router"])
+    probs = jax.nn.softmax(logits, -1)
+    gate = jnp.max(probs, -1)                    # top-1 gate value
+    eid = jnp.where(valid, jnp.argmax(probs, -1), E)  # padding routes nowhere
+    C = max(1, math.ceil(cfg.capacity_factor * T_loc / E))
+    oh = jax.nn.one_hot(eid, E, dtype=jnp.int32)  # zero row for eid == E
+    pos = jnp.take_along_axis(jnp.cumsum(oh, 0) - 1,
+                              jnp.clip(eid, 0, E - 1)[:, None], 1)[:, 0]
+    kept = (eid < E) & (pos < C)
+    n_drop = jnp.sum((valid & ~kept).astype(jnp.float32))
+    n_tok = jnp.sum(valid.astype(jnp.float32))
+
+    # scatter my tokens into per-expert slots; out-of-capacity rows drop
+    send = jnp.zeros((E, C, D), cfg.dtype).at[eid, pos].set(
+        xs.astype(cfg.dtype), mode="drop")
+    # a2a #1: expert-major chunks -> the shard owning those experts
+    recv = lax.all_to_all(send, MODEL, 0, 0, tiled=True)  # [tp*e_loc, C, D]
+    toks = recv.reshape(tp, e_loc, C, D).transpose(1, 0, 2, 3)
+    toks = toks.reshape(e_loc, tp * C, D)
+    wi = p["wi"].astype(cfg.dtype)
+    wg = p["wg"].astype(cfg.dtype)
+    wo = p["wo_mlp"].astype(cfg.dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", toks, wg)) * \
+        jnp.einsum("ecd,edf->ecf", toks, wi)
+    out = jnp.einsum("ecf,efd->ecd", h, wo)      # [e_loc, tp*C, D]
+    # a2a #2: back to each token's source shard, global-expert-id order
+    back = out.reshape(e_loc, tp, C, D).transpose(1, 0, 2, 3)
+    back = back.reshape(tp * e_loc, C, D)
+    ybuf = lax.all_to_all(back, MODEL, 0, 0, tiled=True)  # [E, C, D]
+    y = ybuf.at[eid, pos].get(mode="fill", fill_value=0)  # [T_loc, D]
+    y = y * (gate * kept.astype(jnp.float32)).astype(cfg.dtype)[:, None]
+
+    # restore the full MODEL-invariant token set (each shard contributes
+    # its slice; the psum is the same row-parallel combine the dense MLP
+    # block ends with)
+    yfull = jnp.zeros((tp, T_loc, D), cfg.dtype).at[my].set(y)
+    yfull = lax.psum(yfull, MODEL).reshape(Tp, D)[:T]
+    stats = (lax.psum(n_drop, MODEL), lax.psum(n_tok, MODEL))
+    return yfull.reshape(b, s, D), stats
+
+
 def _mlp_moe(cfg, p, x):
-    """Expert-parallel switch MLP: local experts, one-hot dispatch, psum."""
+    """Expert-parallel switch MLP: local experts, one-hot dispatch, psum.
+
+    O(tokens x experts) compute — kept as the *oracle* for the routed path
+    (``moe_dispatch='dense'``); with ample capacity the two compute the
+    identical function (tests/test_megatron.py)."""
     e_loc = p["wi"].shape[0]                     # [E/tp, D, F] local experts
     my = lax.axis_index(MODEL)
     router = p["router"]                         # [D, E] replicated
@@ -241,19 +327,28 @@ def _mlp_moe(cfg, p, x):
 
 
 def _stage_forward(cfg, stage_params, x, cos, sin):
-    """Apply this stage's blocks: lax.scan over the stacked layer dim."""
+    """Apply this stage's blocks: lax.scan over the stacked layer dim.
+
+    Returns ``(x, (n_dropped, n_tokens))`` — per-stage MoE dropped-token
+    sums (zeros for dense MLP/dense dispatch), stacked by the scan and
+    summed here so the schedules can thread one scalar pair."""
     def block(x, p):
         h = _rms(x, p["ln_attn"])
         x = x + _attention(cfg, p, h, cos, sin)
         h = _rms(x, p["ln_mlp"])
-        if cfg.n_experts:
+        zero = jnp.zeros((), jnp.float32)
+        stats = (zero, zero)
+        if cfg.n_experts and cfg.moe_dispatch == "routed":
+            y, stats = _mlp_moe_routed(cfg, p, h)
+            x = x + y
+        elif cfg.n_experts:
             x = x + _mlp_moe(cfg, p, h)
         else:
             x = x + _mlp_dense(cfg, p, h)
-        return x, None
+        return x, stats
 
-    x, _ = lax.scan(block, x, stage_params)
-    return x
+    x, stats = lax.scan(block, x, stage_params)
+    return x, jax.tree.map(jnp.sum, stats)
 
 
 # ---------------------------------------------------------------------------
@@ -278,12 +373,17 @@ def _pipeline(cfg, params, x_micro, cos, sin):
     n_ticks = n_micro + n_stages - 1
 
     def tick(carry, t):
-        buf, outputs = carry
+        buf, outputs, drop, tot = carry
         # stage 0 injects microbatch t (garbage after n_micro ticks, masked)
         inject = lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
         buf = jnp.where(stage == 0, inject, buf)
-        y = _stage_forward(cfg, stage_params, buf, cos, sin)
+        y, st = _stage_forward(cfg, stage_params, buf, cos, sin)
+        # this stage holds real (not garbage/masked) data for tick t iff
+        # microbatch t - stage is in range — gate the MoE drop accounting
+        active = ((t - stage) >= 0) & ((t - stage) < n_micro)
+        drop = drop + jnp.where(active, st[0], 0.0)
+        tot = tot + jnp.where(active, st[1], 0.0)
         # last stage collects output microbatch t - (n_stages - 1)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         collect = (stage == n_stages - 1) & (t >= n_stages - 1)
@@ -294,7 +394,7 @@ def _pipeline(cfg, params, x_micro, cos, sin):
                                    outputs, out_idx, 0, keepdims=False)),
             out_idx, 0)
         buf = lax.ppermute(y, PIPE, perm)
-        return (buf, outputs), None
+        return (buf, outputs, drop, tot), None
 
     # Carry vma: activations vary over the batch axes and (once stage params
     # touch them) 'pipe'; they stay *invariant* over 'model' because every
@@ -309,12 +409,14 @@ def _pipeline(cfg, params, x_micro, cos, sin):
     buf0 = lax.pcast(jnp.zeros(mb_shape, cfg.dtype), vary_axes, to="varying")
     outs0 = lax.pcast(jnp.zeros((n_micro,) + mb_shape, cfg.dtype),
                       vary_axes, to="varying")
-    (_, outputs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(n_ticks))
+    stat0 = lax.pcast(jnp.zeros((), jnp.float32), vary_axes, to="varying")
+    (_, outputs, drop, tot), _ = lax.scan(
+        tick, (buf0, outs0, stat0, stat0), jnp.arange(n_ticks))
     # broadcast last stage's outputs to every stage (head/loss replicated)
     outputs = lax.psum(
         jnp.where(stage == n_stages - 1, outputs,
                   jnp.zeros_like(outputs)), PIPE)
-    return outputs
+    return outputs, (drop, tot)
 
 
 def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
@@ -330,7 +432,7 @@ def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
 
     mb = b_loc // n_micro
     x_micro = x.reshape(n_micro, mb, s_loc, cfg.d_model)
-    y = _pipeline(cfg, params, x_micro, cos, sin)
+    y, (drop, tot) = _pipeline(cfg, params, x_micro, cos, sin)
     y = y.reshape(b_loc, s_loc, cfg.d_model)
 
     y = _rms(y, params["ln_f"])
@@ -342,24 +444,48 @@ def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
     local_sum = jnp.sum((lse - true_logit) * mask)
     total = lax.psum(jnp.sum(mask), (DATA, SEQ))
     loss = lax.psum(local_sum, (DATA, SEQ)) / jnp.maximum(total, 1.0)
-    return loss
+    aux = (lax.psum(drop, (DATA, SEQ, PIPE)), lax.psum(tot, (DATA, SEQ, PIPE)))
+    return loss, aux
 
 
 # ---------------------------------------------------------------------------
 # the 1F1B schedule (explicit-VJP pipeline, inside shard_map)
 # ---------------------------------------------------------------------------
 
-def bubble_fraction(cfg: MegatronConfig) -> float:
-    """Idle fraction of the 1F1B schedule: 2(S-1) of M+2(S-1) ticks.
+def n_pipeline_ticks(cfg: MegatronConfig) -> int:
+    """Combined fwd+bwd tick count of the (interleaved) 1F1B scan.
 
-    Each tick carries one forward and one backward lane; a stage is idle in a
-    lane for (S-1) warmup + (S-1) cooldown ticks.  GPipe has the same bubble
-    — 1F1B's win is peak memory: at most ``min(M, 2S-1)`` in-flight
-    microbatch activations per stage instead of all M (plus, here, the loss
-    head's full output never being broadcast across stages).
+    General formula for v = ``virtual_stages`` chunks per device: the last
+    microbatch's chunk-0 backward lands at
+    ``(vS-1) + (S-1) + g*vS + (v-1)S + j`` where ``(g, j) = divmod(M-1, S)``.
+    v=1 reduces to the classic ``M + 2(S-1)``.
     """
-    s, m = cfg.n_stages, cfg.n_microbatches
-    return 2 * (s - 1) / (m + 2 * (s - 1))
+    S, M, v = cfg.n_stages, cfg.n_microbatches, cfg.virtual_stages
+    g, j = divmod(M - 1, S)
+    return (v * S - 1) + (S - 1) + g * v * S + (v - 1) * S + j + 1
+
+
+def bubble_fraction(cfg: MegatronConfig) -> float:
+    """Idle fraction of the (interleaved) 1F1B scan: 1 - M*v / n_ticks.
+
+    Each tick carries one forward-chunk and one backward-chunk lane (1/v of
+    a stage's layers each), so useful lane-ticks are ``M*v`` of
+    ``n_pipeline_ticks``.  v=1 gives ``2(S-1) / (M + 2(S-1))``.  Raising v
+    shrinks the idle *time* toward ``~S(v+1)/(2v)`` chunk-times (half the
+    v=1 bubble asymptotically) — the lockstep two-lane scan can't reach
+    Megatron's 1/v interleaved bound, which needs per-device fwd/bwd slot
+    scheduling rather than SPMD lanes.
+
+    Relative to the GPipe path (`_loss_fn`): GPipe's scan runs M + S - 1
+    forward ticks and lets autodiff replay them backward, so its combined
+    idle fraction is *lower* per tick but its peak memory holds all M
+    microbatch activations; this schedule trades lockstep head/VJP
+    arithmetic on every stage for ``min(k_span, 2vS-1)`` saved chunk
+    inputs (k_span = M*v when M % S == 0)
+    and no cross-stage broadcast.
+    """
+    m, v = cfg.n_microbatches, cfg.virtual_stages
+    return 1.0 - (m * v) / n_pipeline_ticks(cfg)
 
 
 def _vary(x, axes):
@@ -410,36 +536,48 @@ def _head_loss(cfg, emb, ln_f, y, targets, mask, inv_total):
 
 
 def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
-    """(loss, grads) via an explicit 1F1B pipeline schedule.  Inside shard_map.
+    """(loss, grads) via an explicit (interleaved) 1F1B schedule.  Inside
+    shard_map.
 
-    One ``lax.scan`` over ``M + 2(S-1)`` ticks.  Per tick, every stage runs
-    one forward (microbatch ``t - stage``) *and* one backward (microbatch
-    ``t - 2(S-1) + stage``, rematerialized ``jax.vjp`` of the stage), so the
-    last stage backprops a microbatch the same tick it finishes its forward
-    — the 1F1B steady state.  Two ``ppermute``s per tick move activations up
-    and gradients down the 'pipe' ring.  Input embeddings are looked up (and
-    their cotangent scatter-added) per microbatch inside the tick, so no
-    O(M) activation or cotangent buffer exists anywhere.  Compared with
-    autodiff through the GPipe scan (`_loss_fn`), this (a) caps live
-    activations at ``min(M, 2S-1)`` stage *inputs* (remat recomputes the
-    rest), (b) never
-    psum-broadcasts stage outputs — only the last stage's head result is
-    used, and only scalar loss + per-microbatch dy leave it (the redundancy
-    the round-1 review flagged), and (c) shards the head's vocab dim over
-    'model'.  SPMD lockstep means every device still *executes* the head
-    each tick (results masked off-stage) — the schedule trades that
-    arithmetic for never materializing or broadcasting cross-stage state.
+    One ``lax.scan`` over :func:`n_pipeline_ticks` ticks.  Per tick, every
+    device runs one forward *chunk* and one backward *chunk* (rematerialized
+    ``jax.vjp``), where a chunk is ``layers_per_stage / virtual_stages`` of
+    its layers.  With ``v = virtual_stages`` chunks per device the model is
+    a virtual pipeline of depth ``V = v*S`` whose hops always target the
+    next/prev device on the 'pipe' ring (chunk c on device S-1 wraps to
+    chunk c+1 on device 0), so the two ``ppermute``s per tick are unchanged
+    from the plain schedule.  Forward index math at tick ``t`` on device
+    ``s``: ``t' = t - s``, group ``g = t' // (vS)``, chunk
+    ``c = (t' mod vS) // S``, microbatch ``m = g*S + (t' mod S)`` — v=1
+    reduces to the classic ``m = t - s``.  The backward lane mirrors it
+    shifted by ``(vS-1) + (S-1-s)``, so the last device backprops a
+    microbatch's final chunk the same tick it finishes its forward — the
+    1F1B steady state, at any v.
 
-    Replaces ``jax.value_and_grad(_loss_fn)``; gradient reductions that fell
-    out of VMA-typed autodiff there are explicit here: stage/embed/ln_f
-    cotangents are accumulated locally (params pcast varying) and psummed
-    once after the scan.
+    Compared with autodiff through the GPipe scan (`_loss_fn`), this (a)
+    caps live activations at ``min(k_span, 2vS-1)`` chunk *inputs* (remat
+    recomputes the rest), (b) never psum-broadcasts stage outputs — only
+    scalar loss + per-microbatch dy leave the last device, and (c) shards
+    the head's vocab dim over 'model'.  SPMD lockstep means every device
+    still *executes* the head each tick (results masked off-stage).
+
+    Gradient reductions that fall out of VMA-typed autodiff in `_loss_fn`
+    are explicit here: chunk/embed/ln_f cotangents are accumulated locally
+    (params pcast varying) and psummed once after the scan.  The head and
+    input-embedding cotangents share ONE [V, D] accumulator (the head's
+    contribution is MODEL-sharded by the vocab-parallel head; the input
+    side is pre-divided by tp so the single psum over all axes is exact).
     """
-    S, M = cfg.n_stages, cfg.n_microbatches
+    S, M, v = cfg.n_stages, cfg.n_microbatches, cfg.virtual_stages
+    if cfg.layers_per_stage % v:
+        raise ValueError(f"virtual_stages={v} must divide "
+                         f"layers_per_stage={cfg.layers_per_stage}")
+    Lc = cfg.layers_per_stage // v           # layers per chunk
     b_loc, s_loc = tokens.shape
     mb = b_loc // M
     D = cfg.d_model
     stage = lax.axis_index(PIPE)
+    tp = lax.axis_size(MODEL)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq)
 
     inv_total = 1.0 / jnp.maximum(
@@ -452,16 +590,24 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
     p_stage = jax.tree.map(lambda a: _vary(a[0], (DATA, SEQ)),
                            params["blocks"])
     emb_v = _vary(params["embed"], (DATA, SEQ, PIPE, MODEL))
-    emb_in_v = _vary(params["embed"], (DATA, SEQ, PIPE))
     lnf_v = _vary(params["ln_f"], (DATA, SEQ, PIPE))
 
-    def stage_fn(p, x):
-        return _stage_forward(cfg, p, x, cos, sin)
+    def chunk_params(c):
+        return jax.tree.map(
+            lambda a: lax.dynamic_slice_in_dim(a, c * Lc, Lc, 0), p_stage)
+
+    def chunk_fn(p, x):
+        return _stage_forward(cfg, p, x, cos, sin)[0]
 
     perm_up = [(i, (i + 1) % S) for i in range(S)]
     perm_down = [(i, (i - 1) % S) for i in range(S)]
-    n_slots = min(M, 2 * S - 1)
-    n_ticks = M + 2 * (S - 1)
+    # ring-buffer slots for saved chunk inputs, keyed by the dense fwd-order
+    # index k = g*vS + cS + j.  With a partial last group (M % S != 0) k is
+    # not dense, so the small-M cap is the k-range, not M*v.
+    g_last, j_last = divmod(M - 1, S)
+    k_span = g_last * v * S + (v - 1) * S + j_last + 1
+    n_slots = min(k_span, 2 * v * S - 1)
+    n_ticks = n_pipeline_ticks(cfg)
 
     act_axes = tuple(sorted(set(jax.typeof(tok_micro).vma or ())))
     zeros_act = lambda shape: _vary(jnp.zeros(shape, cfg.dtype), act_axes)
@@ -471,27 +617,55 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
         x_saved=zeros_act((n_slots, mb, s_loc, D)),
         dw=jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), p_stage),
         demb=jnp.zeros_like(emb_v, jnp.float32),
-        demb_in=jnp.zeros_like(emb_in_v, jnp.float32),
         dlnf=jnp.zeros_like(lnf_v, jnp.float32),
         loss=_vary(jnp.zeros((), jnp.float32), act_axes),
+        drop=_vary(jnp.zeros((), jnp.float32), act_axes),
+        tot=_vary(jnp.zeros((), jnp.float32), act_axes),
     )
 
+    def fwd_indices(t):
+        """(active, chunk, microbatch, dense-order k) of this device's
+        forward lane at tick t."""
+        tp_ = t - stage
+        g = jnp.floor_divide(tp_, v * S)
+        w = jnp.mod(tp_, v * S)
+        c = jnp.floor_divide(w, S)
+        m = g * S + jnp.mod(w, S)
+        active = (tp_ >= 0) & (m < M)
+        return active, c, jnp.clip(m, 0, M - 1), jnp.maximum(tp_, 0)
+
+    def bwd_indices(t):
+        """Mirror of fwd_indices, shifted by (vS-1) + (S-1-stage); the
+        chunk counter runs top-down (chunk = v-1 - c')."""
+        tb = t - (v * S - 1) - (S - 1 - stage)
+        g = jnp.floor_divide(tb, v * S)
+        w = jnp.mod(tb, v * S)
+        cprime = jnp.floor_divide(w, S)
+        j = jnp.mod(w, S)
+        m = g * S + j
+        active = (tb >= 0) & (m < M)
+        chunk = v - 1 - cprime
+        # dense fwd-order index of the entry being backproped (its slot)
+        k = g * (v * S) + chunk * S + j
+        return active, chunk, jnp.clip(m, 0, M - 1), jnp.maximum(k, 0)
+
     def tick(carry, t):
-        # ---- forward lane: microbatch m_f enters this stage -------------
-        m_f = t - stage
-        f_active = (m_f >= 0) & (m_f < M)
-        m_idx = jnp.clip(m_f, 0, M - 1)
+        # ---- forward lane: chunk c_f of microbatch m_f ------------------
+        f_active, c_f, m_idx, k_f = fwd_indices(t)
         tok_f = lax.dynamic_index_in_dim(tok_micro, m_idx, 0, keepdims=False)
         inject = jnp.take(params["embed"], tok_f, axis=0).astype(cfg.dtype)
-        x_in = jnp.where(stage == 0, inject, carry["buf_f"])
-        slot_f = jnp.mod(m_idx, n_slots)
+        x_in = jnp.where((stage == 0) & (c_f == 0), inject, carry["buf_f"])
+        slot_f = jnp.mod(k_f, n_slots)
         old = lax.dynamic_index_in_dim(carry["x_saved"], slot_f, 0,
                                        keepdims=False)
         x_saved = lax.dynamic_update_index_in_dim(
             carry["x_saved"], jnp.where(f_active, x_in, old), slot_f, 0)
-        y = stage_fn(p_stage, x_in)
+        p_f = chunk_params(c_f)
+        y, st = _stage_forward(cfg, p_f, x_in, cos, sin)
+        drop = carry["drop"] + jnp.where(f_active, st[0], 0.0)
+        tot = carry["tot"] + jnp.where(f_active, st[1], 0.0)
 
-        # ---- head on the forward output (used on the last stage only) --
+        # ---- head on the final chunk's output (last device only) -------
         tgt = lax.dynamic_index_in_dim(tgt_micro, m_idx, 0, keepdims=False)
         msk = lax.dynamic_index_in_dim(msk_micro, m_idx, 0, keepdims=False)
         loss_m, head_vjp = jax.vjp(
@@ -499,49 +673,56 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
             emb_v, lnf_v, y)
         demb_m, dlnf_m, dy_head = head_vjp(
             _vary(jnp.float32(1.0), jax.typeof(loss_m).vma or ()))
-        head_active = (stage == S - 1) & f_active
+        head_active = (stage == S - 1) & (c_f == v - 1) & f_active
         loss = carry["loss"] + jnp.where(head_active, loss_m, 0.0)
         demb = carry["demb"] + jnp.where(head_active, demb_m, 0.0)
         dlnf = carry["dlnf"] + jnp.where(head_active, dlnf_m, 0.0)
 
-        # ---- backward lane: microbatch u_b leaves this stage ------------
-        u_b = t - 2 * (S - 1) + stage
-        b_active = (u_b >= 0) & (u_b < M)
-        u_idx = jnp.clip(u_b, 0, M - 1)
-        x_b = lax.dynamic_index_in_dim(x_saved, jnp.mod(u_idx, n_slots), 0,
+        # ---- backward lane: chunk c_b of microbatch u_b -----------------
+        b_active, c_b, u_idx, k_b = bwd_indices(t)
+        x_b = lax.dynamic_index_in_dim(x_saved, jnp.mod(k_b, n_slots), 0,
                                        keepdims=False)
-        dy = jnp.where(stage == S - 1, dy_head, carry["buf_b"])
-        _, stage_vjp = jax.vjp(stage_fn, p_stage, x_b)
-        dw_m, dx = stage_vjp(dy)
-        dw = jax.tree.map(
-            lambda a, d: a + jnp.where(b_active, d, 0.0), carry["dw"], dw_m)
-        # embedding cotangent of this microbatch (scatter-add), stage 0 only
+        dy = jnp.where((stage == S - 1) & (c_b == v - 1),
+                       dy_head, carry["buf_b"])
+        p_b = chunk_params(c_b)
+        _, chunk_vjp = jax.vjp(chunk_fn, p_b, x_b)
+        dw_m, dx = chunk_vjp(dy)
+
+        def acc_chunk(a, d):
+            cur = lax.dynamic_slice_in_dim(a, c_b * Lc, Lc, 0)
+            return lax.dynamic_update_slice_in_dim(
+                a, cur + jnp.where(b_active, d, 0.0), c_b * Lc, 0)
+
+        dw = jax.tree.map(acc_chunk, carry["dw"], dw_m)
+        # input-embedding cotangent (scatter-add), device 0 chunk 0 only;
+        # pre-divided by tp so it can share the MODEL-psummed accumulator
         tok_b = lax.dynamic_index_in_dim(tok_micro, u_idx, 0, keepdims=False)
         _, embed_vjp = jax.vjp(
-            lambda e: jnp.take(e, tok_b, axis=0).astype(cfg.dtype), emb_in_v)
-        (demb_u,) = embed_vjp(dx)
-        demb_in = carry["demb_in"] + jnp.where(
-            b_active & (stage == 0), demb_u, 0.0)
+            lambda e: jnp.take(e, tok_b, axis=0).astype(cfg.dtype), emb_v)
+        (demb_u,) = embed_vjp(_vary(dx, (MODEL,)))
+        demb = demb + jnp.where(
+            b_active & (stage == 0) & (c_b == 0), demb_u / tp, 0.0)
 
         # ---- ring handoffs ---------------------------------------------
         new_carry = dict(
             buf_f=lax.ppermute(y, PIPE, perm_up),
             buf_b=lax.ppermute(dx, PIPE, perm_down),
-            x_saved=x_saved, dw=dw, demb=demb, demb_in=demb_in,
-            dlnf=dlnf, loss=loss)
+            x_saved=x_saved, dw=dw, demb=demb,
+            dlnf=dlnf, loss=loss, drop=drop, tot=tot)
         return new_carry, None
 
     carry, _ = lax.scan(tick, carry0, jnp.arange(n_ticks))
 
     # ---- combine cotangents into global-layout grads ---------------------
-    demb = (lax.psum(carry["demb"], (DATA, SEQ, PIPE, MODEL))
-            + lax.psum(carry["demb_in"], (DATA, SEQ, PIPE)))
+    demb = lax.psum(carry["demb"], (DATA, SEQ, PIPE, MODEL))
     dlnf = lax.psum(carry["dlnf"], (DATA, SEQ, PIPE))
     dblocks = jax.tree.map(lambda a: lax.psum(a, (DATA, SEQ))[None],
                            carry["dw"])
     loss = lax.psum(carry["loss"], (DATA, SEQ, PIPE))
     grads = {"embed": demb, "ln_f": dlnf, "blocks": dblocks}
-    return loss, grads
+    aux = (lax.psum(carry["drop"], (DATA, SEQ, PIPE)),
+           lax.psum(carry["tot"], (DATA, SEQ, PIPE)))
+    return loss, grads, aux
 
 
 # ---------------------------------------------------------------------------
@@ -579,23 +760,33 @@ def make_megatron_train_step(cfg: MegatronConfig, mesh: Mesh, optimizer):
 
     if cfg.schedule not in ("1f1b", "gpipe"):
         raise ValueError(f"unknown pipeline schedule {cfg.schedule!r}")
+    if cfg.schedule == "gpipe" and cfg.virtual_stages != 1:
+        raise ValueError("virtual_stages (interleaved schedule) requires "
+                         "schedule='1f1b'")
 
     def step(params, opt_state, tokens, targets, mask):
         if cfg.schedule == "1f1b":
-            loss, grads = _value_and_grad_1f1b(cfg, params, tokens,
-                                               targets, mask)
+            loss, grads, aux = _value_and_grad_1f1b(cfg, params, tokens,
+                                                    targets, mask)
         else:
-            loss, grads = jax.value_and_grad(
-                partial(_loss_fn, cfg))(params, tokens, targets, mask)
+            (loss, aux), grads = jax.value_and_grad(
+                partial(_loss_fn, cfg), has_aux=True)(
+                    params, tokens, targets, mask)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
-        return params, opt_state, loss
+        metrics = {}
+        if cfg.n_experts and cfg.moe_dispatch == "routed":
+            drop, tot = aux
+            metrics["moe_dropped_frac"] = drop / jnp.maximum(tot, 1.0)
+        return params, opt_state, loss, metrics
 
+    metric_spec = ({"moe_dropped_frac": P()}
+                   if cfg.n_experts and cfg.moe_dispatch == "routed" else {})
     batch_spec = P(DATA, SEQ)
     mapped = jax.shard_map(
         step, mesh=mesh,
         in_specs=(specs, o_specs, batch_spec, batch_spec, batch_spec),
-        out_specs=(specs, o_specs, P()),
+        out_specs=(specs, o_specs, P(), metric_spec),
     )
     return jax.jit(mapped, donate_argnums=(0, 1))
 

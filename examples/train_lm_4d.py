@@ -102,11 +102,13 @@ def main():
             "targets": toks[:, 1:].astype(np.int32),
             "mask": np.ones((B, S), np.float32),
         })
-        params, opt_state, loss = step(params, opt_state, batch["tokens"],
-                                       batch["targets"], batch["mask"])
+        params, opt_state, loss, metrics = step(
+            params, opt_state, batch["tokens"], batch["targets"],
+            batch["mask"])
         if i % args.log_interval == 0:
             reporter.report({"step": i, "loss": float(loss),
-                             "mesh": str(shape)})
+                             "mesh": str(shape),
+                             **{k: float(v) for k, v in metrics.items()}})
     print(f"final loss {float(loss):.4f} on mesh {shape}", flush=True)
 
 
