@@ -62,8 +62,10 @@ def load_mnist(root: str = "./datasets", flatten: bool = False):
             train = (z["x_train"], z["y_train"])
             test = (z["x_test"], z["y_test"])
     else:
-        log.warning("MNIST files not found under %s — using deterministic "
-                    "synthetic data", mdir)
+        log.warning(
+            "=== SYNTHETIC DATA IN USE === MNIST files not found under %s; "
+            "training on DETERMINISTIC SYNTHETIC images. Loss/accuracy are "
+            "NOT comparable to real MNIST.", mdir)
         (tr_i, tr_l), (te_i, te_l) = synthetic.synthetic_mnist()
         train, test = (tr_i, tr_l), (te_i, te_l)
 
@@ -80,17 +82,113 @@ def load_mnist(root: str = "./datasets", flatten: bool = False):
     return prep(*train), prep(*test)
 
 
-def load_cifar10(root: str = "./datasets"):
-    """CIFAR-10 from the python pickle batches; synthetic fallback."""
-    cdir = None
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"  # torchvision's tgz_md5
+
+
+def download_cifar10(root: str, url: str | None = None,
+                     md5: str | None = None) -> str:
+    """Fetch, checksum-verify, and extract the CIFAR-10 python batches.
+
+    Parity with the reference's ``CIFAR10(root, download=True)``
+    (reference pytorch/single_gpu.py:57,
+    pytorch/distributed_data_parallel.py:85): idempotent (skips the fetch
+    when the verified archive is already present), MD5-checked with the
+    same constant torchvision pins, atomic (.part rename).  Returns the
+    extracted ``cifar-10-batches-py`` directory.
+    """
+    import hashlib
+    import shutil
+    import tarfile
+    import urllib.request
+
+    url = url or CIFAR10_URL
+    md5 = md5 or CIFAR10_MD5
+    os.makedirs(root, exist_ok=True)
+    tgz = os.path.join(root, "cifar-10-python.tar.gz")
+    if not os.path.exists(tgz):
+        log.info("downloading CIFAR-10 from %s to %s", url, tgz)
+        tmp = tgz + ".part"
+        with urllib.request.urlopen(url, timeout=120) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+        os.replace(tmp, tgz)
+    h = hashlib.md5()
+    with open(tgz, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    if h.hexdigest() != md5:
+        os.remove(tgz)
+        raise IOError(f"CIFAR-10 archive checksum mismatch: got "
+                      f"{h.hexdigest()}, want {md5} — corrupt download "
+                      f"removed, retry")
+    # atomic extraction: unpack into a scratch dir, verify every batch
+    # file, then one os.replace — an interrupted run can never leave a
+    # half-extracted cifar-10-batches-py that later loads partially
+    scratch = tgz + ".extract"
+    shutil.rmtree(scratch, ignore_errors=True)
+    with tarfile.open(tgz, "r:gz") as tf:
+        tf.extractall(scratch, filter="data")
+    src = os.path.join(scratch, "cifar-10-batches-py")
+    missing = [n for n in _CIFAR_BATCHES
+               if not os.path.exists(os.path.join(src, n))]
+    if missing:
+        shutil.rmtree(scratch, ignore_errors=True)
+        raise IOError(f"archive extracted but missing {missing}")
+    out = os.path.join(root, "cifar-10-batches-py")
+    shutil.rmtree(out, ignore_errors=True)   # replace any partial leftover
+    os.replace(src, out)
+    shutil.rmtree(scratch, ignore_errors=True)
+    return out
+
+
+_CIFAR_BATCHES = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
+
+
+def _find_cifar10_dir(root: str) -> str | None:
+    """A directory only counts when EVERY batch file is present — a partial
+    (interrupted) extraction must trigger re-download, not a late crash."""
     for cand in ("cifar-10-batches-py", "cifar10", "."):
         d = os.path.join(root, cand)
-        if os.path.exists(os.path.join(d, "data_batch_1")):
-            cdir = d
-            break
+        if all(os.path.exists(os.path.join(d, n)) for n in _CIFAR_BATCHES):
+            return d
+    return None
+
+
+def load_cifar10(root: str = "./datasets", download: bool = True):
+    """CIFAR-10 from the python pickle batches.
+
+    When the batches are missing and ``download=True`` (the reference's
+    default behavior), they are fetched and checksum-verified first —
+    **leader-gated**: in a multi-process world only process 0 downloads
+    and extracts, everyone else waits at a barrier and re-scans, so ranks
+    sharing a dataset root never race on the archive (the same
+    is_leader/barrier discipline the checkpointer uses).  Only if the
+    download also fails (e.g. no network egress) does the LOUD
+    deterministic synthetic fallback engage — it never silently stands in
+    for the real dataset.
+    """
+    from dtdl_tpu.runtime.bootstrap import barrier, is_leader
+
+    cdir = _find_cifar10_dir(root)
+    if download:
+        # every process takes this path (the barrier must be collective
+        # even for ranks that already see the extracted directory)
+        if cdir is None and is_leader():
+            try:
+                download_cifar10(root)
+            except Exception as e:  # no egress / bad mirror: loud fallback
+                log.error("CIFAR-10 download failed (%s: %s)",
+                          type(e).__name__, e)
+        barrier("cifar10_download")
+        cdir = _find_cifar10_dir(root)
     if cdir is None:
-        log.warning("CIFAR-10 batches not found under %s — using "
-                    "deterministic synthetic data", root)
+        log.warning(
+            "=== SYNTHETIC DATA IN USE === CIFAR-10 not found under %s and "
+            "download failed/disabled; training on DETERMINISTIC SYNTHETIC "
+            "images. Loss/accuracy are NOT comparable to real CIFAR-10 — "
+            "place cifar-10-python.tar.gz under the dataset root or enable "
+            "network access.", root)
         (tr_i, tr_l), (te_i, te_l) = synthetic.synthetic_cifar10()
         return (tr_i, tr_l), (te_i, te_l)
 

@@ -210,6 +210,7 @@ class Estimator:
             epoch += 1
         if global_step != last_saved:
             self.ckpt.save(global_step, state)
+        self.ckpt.wait_until_finished()   # async saves durable before return
         return self
 
     def evaluate(self, input_fn: Callable, steps: int | None = None) -> dict:

@@ -67,6 +67,11 @@ class ModelCheckpoint(Callback):
         else:
             self.ckpt.save(epoch, self.model.state)
 
+    def on_train_end(self) -> None:
+        # full-state saves are async; block so restore-latest-then-evaluate
+        # (reference tensorflow2/mnist_single.py:88-92) sees the snapshot
+        self.ckpt.wait_until_finished()
+
 
 class TensorBoard(Callback):
     """TensorBoard events when available (reference mnist_single.py:72-73)."""

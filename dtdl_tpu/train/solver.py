@@ -266,4 +266,5 @@ class Solver:
             last = {k: float(v) for k, v in metrics.items()}
         if snap:
             self.snapshot()
+        self.ckpt.wait_until_finished()   # async saves durable before return
         return last

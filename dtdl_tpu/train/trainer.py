@@ -120,6 +120,14 @@ class Trainer:
         return count >= self.stop.period
 
     def run(self) -> None:
+        try:
+            self._run()
+        finally:
+            # snapshots save asynchronously; make them durable before the
+            # process moves on (a fresh Trainer may resume immediately)
+            self.ckpt.wait_until_finished()
+
+    def _run(self) -> None:
         while not self._done:
             self.train_loader.set_epoch(self.epoch)
             self.timer.reset_epoch()
@@ -156,6 +164,10 @@ class Trainer:
     # -- snapshot / resume ----------------------------------------------------
 
     def save_snapshot(self) -> str:
+        # the state snapshot is asynchronous (overlaps training); the meta
+        # sidecar lives NEXT TO the snapshot dir (snapshot_N.meta.json), not
+        # inside it — the dir keeps its orbax tmp name until the background
+        # write finalizes
         path = self.ckpt.save(self.iteration, self.state)
         meta = {
             "iteration": self.iteration,
@@ -165,7 +177,7 @@ class Trainer:
                            for name, ext, _ in self._extensions},
         }
         if is_leader():
-            with open(os.path.join(path, "trainer_meta.json"), "w") as f:
+            with open(path + ".meta.json", "w") as f:
                 json.dump(meta, f)
         return path
 
@@ -173,13 +185,17 @@ class Trainer:
         """Restore trainer state; empty path = latest snapshot in out/."""
         if path:
             state = self.ckpt.restore_path(self.state, path)
-            meta_path = os.path.join(path, "trainer_meta.json")
+            meta_path = path.rstrip("/") + ".meta.json"
+            legacy = os.path.join(path, "trainer_meta.json")
         else:
             state, step = self.ckpt.restore(self.state)
             if state is None:
                 return False
-            meta_path = os.path.join(self.out, f"snapshot_{step}",
-                                     "trainer_meta.json")
+            meta_path = os.path.join(self.out, f"snapshot_{step}.meta.json")
+            legacy = os.path.join(self.out, f"snapshot_{step}",
+                                  "trainer_meta.json")
+        if not os.path.exists(meta_path) and os.path.exists(legacy):
+            meta_path = legacy   # snapshots written before the sidecar move
         self.state = state
         if os.path.exists(meta_path):
             with open(meta_path) as f:

@@ -40,9 +40,12 @@ def test_s2d_stem_grads_flow_to_7x7_kernel():
     assert float(jnp.min(jnp.sum(jnp.abs(g), axis=(2, 3)))) > 0.0
 
 
-def test_resnet50_forward_shapes_odd_input_falls_back():
-    """Odd spatial dims can't space-to-depth; the standard conv path runs."""
-    model = resnet50(num_classes=10)
+def test_resnet_forward_shapes_odd_input_falls_back():
+    """Odd spatial dims can't space-to-depth; the standard conv path runs.
+    A one-block-per-stage ResNet keeps this a sub-second check — the stem
+    logic under test is identical to ResNet-50's."""
+    from dtdl_tpu.models.resnet import ResNet
+    model = ResNet(stage_sizes=(1, 1, 1, 1), num_classes=10)
     x = jnp.zeros((1, 33, 33, 3))
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     out = model.apply(variables, x, train=False)
