@@ -145,6 +145,27 @@ def download_cifar10(root: str, url: str | None = None,
 _CIFAR_BATCHES = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
 
 
+def _download_locked(root: str, timeout: float = 600.0) -> None:
+    """download_cifar10 guarded by an exclusive lockfile: the winner
+    fetches, everyone else sharing this filesystem polls for the result."""
+    import time
+    os.makedirs(root, exist_ok=True)
+    lock = os.path.join(root, ".cifar10.download.lock")
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        deadline = time.time() + timeout
+        while os.path.exists(lock) and time.time() < deadline:
+            time.sleep(1.0)
+        return  # loser: the winner extracted (or failed); caller re-scans
+    try:
+        os.close(fd)
+        if _find_cifar10_dir(root) is None:
+            download_cifar10(root)
+    finally:
+        os.unlink(lock)
+
+
 def _find_cifar10_dir(root: str) -> str | None:
     """A directory only counts when EVERY batch file is present — a partial
     (interrupted) extraction must trigger re-download, not a late crash."""
@@ -170,6 +191,8 @@ def load_cifar10(root: str = "./datasets", download: bool = True):
     """
     from dtdl_tpu.runtime.bootstrap import barrier, is_leader
 
+    if os.environ.get("DTDL_OFFLINE"):
+        download = False     # CI / air-gapped: never touch the network
     cdir = _find_cifar10_dir(root)
     if download:
         # every process takes this path (the barrier must be collective
@@ -182,6 +205,17 @@ def load_cifar10(root: str = "./datasets", download: bool = True):
                           type(e).__name__, e)
         barrier("cifar10_download")
         cdir = _find_cifar10_dir(root)
+        if cdir is None and not is_leader():
+            # per-host local disks: the leader's download landed on ITS
+            # filesystem, not ours.  Each remaining process fetches into
+            # its own root, one at a time per root via an exclusive
+            # lockfile (same-host processes share the root).
+            try:
+                _download_locked(root)
+            except Exception as e:
+                log.error("CIFAR-10 local download failed (%s: %s)",
+                          type(e).__name__, e)
+            cdir = _find_cifar10_dir(root)
     if cdir is None:
         log.warning(
             "=== SYNTHETIC DATA IN USE === CIFAR-10 not found under %s and "

@@ -183,34 +183,38 @@ class Estimator:
         epoch = global_step // steps_per_epoch
         skip = global_step % steps_per_epoch
         last_saved = global_step
-        while global_step < target:
-            loader.set_epoch(epoch)
-            raw = resume_iter(loader, skip)
-            skip = 0
-            it = prefetch_to_device(raw, self.strategy.shard_batch, 2)
-            for batch in it:
-                if global_step >= target:
-                    break
-                state, metrics = train_step(state, batch)
-                global_step += 1
-                if (cfg.log_step_count_steps
-                        and global_step % cfg.log_step_count_steps == 0):
-                    dt = time.time() - t0
-                    rate = (global_step - logged_at) / max(dt, 1e-9)
-                    t0, logged_at = time.time(), global_step
-                    self.reporter.report({
-                        "global_step": global_step,
-                        "loss": float(metrics["loss"]),
-                        "global_step/sec": round(rate, 2),
-                    })
-                if (cfg.save_checkpoints_steps
-                        and global_step % cfg.save_checkpoints_steps == 0):
-                    self.ckpt.save(global_step, state)
-                    last_saved = global_step
-            epoch += 1
-        if global_step != last_saved:
-            self.ckpt.save(global_step, state)
-        self.ckpt.wait_until_finished()   # async saves durable before return
+        try:
+            while global_step < target:
+                loader.set_epoch(epoch)
+                raw = resume_iter(loader, skip)
+                skip = 0
+                it = prefetch_to_device(raw, self.strategy.shard_batch, 2)
+                for batch in it:
+                    if global_step >= target:
+                        break
+                    state, metrics = train_step(state, batch)
+                    global_step += 1
+                    if (cfg.log_step_count_steps
+                            and global_step % cfg.log_step_count_steps == 0):
+                        dt = time.time() - t0
+                        rate = (global_step - logged_at) / max(dt, 1e-9)
+                        t0, logged_at = time.time(), global_step
+                        self.reporter.report({
+                            "global_step": global_step,
+                            "loss": float(metrics["loss"]),
+                            "global_step/sec": round(rate, 2),
+                        })
+                    if (cfg.save_checkpoints_steps
+                            and global_step % cfg.save_checkpoints_steps == 0):
+                        self.ckpt.save(global_step, state)
+                        last_saved = global_step
+                epoch += 1
+            if global_step != last_saved:
+                self.ckpt.save(global_step, state)
+        finally:
+            # async saves durable before return — including on an exception
+            # mid-train, so a --max-restarts relaunch sees the newest snapshot
+            self.ckpt.wait_until_finished()
         return self
 
     def evaluate(self, input_fn: Callable, steps: int | None = None) -> dict:

@@ -179,26 +179,32 @@ class Model:
             cb.on_train_begin()
         reporter = Reporter([StdoutSink()]) if verbose else None
         loader = self._loader(x, y, batch_size, shuffle, seed)
-        for epoch in range(epochs):
+        try:
+            for epoch in range(epochs):
+                for cb in cbs:
+                    cb.on_epoch_begin(epoch)
+                loader.set_epoch(epoch)
+                acc = Accumulator()
+                it = prefetch_to_device(iter(loader),
+                                        self.strategy.shard_batch)
+                for batch in it:
+                    self.state, metrics = self._train_step(self.state, batch)
+                    acc.add({k: float(v) for k, v in metrics.items()})
+                logs = acc.means()
+                if validation_data is not None:
+                    vx, vy = validation_data
+                    val = self.evaluate(vx, vy, batch_size=batch_size,
+                                        verbose=0)
+                    logs.update({f"val_{k}": v for k, v in val.items()})
+                if reporter is not None:
+                    reporter.report({"epoch": epoch, **logs})
+                for cb in cbs:
+                    cb.on_epoch_end(epoch, logs)
+        finally:
+            # on_train_end also flushes pending async checkpoints — run it
+            # on a mid-train crash too, so restarts see the newest snapshot
             for cb in cbs:
-                cb.on_epoch_begin(epoch)
-            loader.set_epoch(epoch)
-            acc = Accumulator()
-            it = prefetch_to_device(iter(loader), self.strategy.shard_batch)
-            for batch in it:
-                self.state, metrics = self._train_step(self.state, batch)
-                acc.add({k: float(v) for k, v in metrics.items()})
-            logs = acc.means()
-            if validation_data is not None:
-                vx, vy = validation_data
-                val = self.evaluate(vx, vy, batch_size=batch_size, verbose=0)
-                logs.update({f"val_{k}": v for k, v in val.items()})
-            if reporter is not None:
-                reporter.report({"epoch": epoch, **logs})
-            for cb in cbs:
-                cb.on_epoch_end(epoch, logs)
-        for cb in cbs:
-            cb.on_train_end()
+                cb.on_train_end()
         return history
 
     def evaluate(self, x, y, batch_size: int = 32, verbose: int = 1) -> dict:

@@ -237,34 +237,38 @@ class Solver:
         # snapshots only happen on iteration (= update) boundaries, so the
         # restored stream position is exactly iteration * iter_size batches
         batches = self.iteration * iter_size
-        while self.iteration < self.max_iter:
-            if steps_per_pass:
-                pass_idx, skip = divmod(batches, steps_per_pass)
-            else:
-                pass_idx, skip = self.iteration, 0
-            self.train_loader.set_epoch(pass_idx)
-            it = prefetch_to_device(resume_iter(self.train_loader, skip),
-                                    self.strategy.shard_batch, 2)
-            for batch in it:
-                if self.iteration >= self.max_iter:
-                    break
-                self.state, metrics = self.train_step(self.state, batch)
-                batches += 1
-                if batches % iter_size:
-                    continue  # mid-accumulation: not an iteration yet
-                self.iteration += 1
-                if display and self.iteration % display == 0:
-                    last = {k: float(v) for k, v in metrics.items()}
-                    self.reporter.report({"iter": self.iteration, **last})
-                if (test_interval and self.test_loader is not None
-                        and self.iteration % test_interval == 0):
-                    last = self.test()
-                    self.reporter.report({"iter": self.iteration, **last})
-                if snap and self.iteration % snap == 0:
-                    self.snapshot()
-        if not last and metrics is not None:
-            last = {k: float(v) for k, v in metrics.items()}
-        if snap:
-            self.snapshot()
-        self.ckpt.wait_until_finished()   # async saves durable before return
+        try:
+            while self.iteration < self.max_iter:
+                if steps_per_pass:
+                    pass_idx, skip = divmod(batches, steps_per_pass)
+                else:
+                    pass_idx, skip = self.iteration, 0
+                self.train_loader.set_epoch(pass_idx)
+                it = prefetch_to_device(resume_iter(self.train_loader, skip),
+                                        self.strategy.shard_batch, 2)
+                for batch in it:
+                    if self.iteration >= self.max_iter:
+                        break
+                    self.state, metrics = self.train_step(self.state, batch)
+                    batches += 1
+                    if batches % iter_size:
+                        continue  # mid-accumulation: not an iteration yet
+                    self.iteration += 1
+                    if display and self.iteration % display == 0:
+                        last = {k: float(v) for k, v in metrics.items()}
+                        self.reporter.report({"iter": self.iteration, **last})
+                    if (test_interval and self.test_loader is not None
+                            and self.iteration % test_interval == 0):
+                        last = self.test()
+                        self.reporter.report({"iter": self.iteration, **last})
+                    if snap and self.iteration % snap == 0:
+                        self.snapshot()
+            if not last and metrics is not None:
+                last = {k: float(v) for k, v in metrics.items()}
+            if snap:
+                self.snapshot()
+        finally:
+            # async saves durable before return — also on a mid-run crash,
+            # so a restarted solver restores the newest snapshot
+            self.ckpt.wait_until_finished()
         return last

@@ -63,6 +63,10 @@ def add_data_flags(parser, dataset="mnist"):
     flag(parser, "--dataset-dir", "--dataset_dir", type=str, default="./datasets",
          help="root containing mnist/*.gz or cifar-10 batches; synthetic "
               "data is generated deterministically when files are absent")
+    flag(parser, "--download", action=argparse.BooleanOptionalAction,
+         default=True,
+         help="fetch missing datasets (checksum-verified; the reference's "
+              "download=True); --no-download or DTDL_OFFLINE=1 disables")
     # no "-j" short alias: the TF2 multi-worker example uses -j for
     # --job_name (reference tensorflow2/mnist_multi_worker_strategy.py flags)
     flag(parser, "--num-workers", type=int, default=0,

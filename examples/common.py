@@ -109,7 +109,8 @@ def cifar_loaders(args, seed: int):
     trains on or the cross-host mixing semantics.
     """
     (xtr, ytr), (xte, yte) = _limit(
-        args, *load_dataset("cifar10", args.dataset_dir))
+        args, *load_dataset("cifar10", args.dataset_dir,
+                            download=getattr(args, "download", True)))
     workers = getattr(args, "num_workers", 0)
     if workers > 0:
         from dtdl_tpu.data.native_loader import NativeDataLoader

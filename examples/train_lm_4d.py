@@ -41,7 +41,19 @@ def main():
     flag(parser, "--layers-per-stage", type=int, default=1)
     flag(parser, "--n-experts", type=int, default=0,
          help="0 = dense MLP; >0 enables expert-parallel MoE")
+    flag(parser, "--moe-dispatch", default="routed",
+         choices=["routed", "dense"],
+         help="MoE dispatch: capacity-factor top-1 + all-to-all (routed) "
+              "or the dense one-hot oracle")
+    flag(parser, "--capacity-factor", type=float, default=1.25,
+         help="per-expert token slots = cf * tokens / n_experts (routed)")
     flag(parser, "--microbatches", type=int, default=2)
+    flag(parser, "--schedule", default="1f1b", choices=["1f1b", "gpipe"],
+         help="pipeline schedule")
+    flag(parser, "--virtual-stages", type=int, default=1,
+         help=">1 = interleaved 1F1B: v layer chunks per device shrink "
+              "the pipeline bubble (requires --schedule 1f1b and "
+              "layers-per-stage divisible by v)")
     flag(parser, "--mesh", default="",
          help="data,seq,pipe,model sizes, e.g. 1,2,2,2 (default: auto)")
     args = parser.parse_args()
@@ -70,7 +82,10 @@ def main():
         d_ff=args.d_ff, n_stages=shape["pipe"],
         layers_per_stage=args.layers_per_stage,
         n_experts=args.n_experts, max_seq=args.seq_len,
-        n_microbatches=args.microbatches)
+        n_microbatches=args.microbatches, schedule=args.schedule,
+        virtual_stages=args.virtual_stages,
+        moe_dispatch=args.moe_dispatch,
+        capacity_factor=args.capacity_factor)
     if args.n_experts and args.n_experts % shape["model"]:
         raise SystemExit(f"--n-experts must be divisible by tp={shape['model']}")
 

@@ -17,6 +17,8 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# tests never hit the network for datasets (fixture file:// URLs only)
+os.environ.setdefault("DTDL_OFFLINE", "1")
 
 import jax  # noqa: E402
 

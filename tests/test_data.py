@@ -216,6 +216,7 @@ def test_cifar10_load_downloads_when_missing(tmp_path, monkeypatch):
     tgz, md5 = _make_cifar_fixture(tmp_path)
     monkeypatch.setattr(datasets, "CIFAR10_URL", tgz.as_uri())
     monkeypatch.setattr(datasets, "CIFAR10_MD5", md5)
+    monkeypatch.delenv("DTDL_OFFLINE", raising=False)  # conftest sets it
     root = str(tmp_path / "root")
     (tr_i, tr_l), _ = datasets.load_cifar10(root)
     assert tr_i.shape == (100, 32, 32, 3)

@@ -20,6 +20,9 @@ CPU_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
     "PYTHONPATH": REPO,
+    # tests must never hit the network (or hang on a blackholed one)
+    # for a throwaway tmp dataset dir — synthetic fallback is the point
+    "DTDL_OFFLINE": "1",
 }
 
 
