@@ -272,7 +272,9 @@ def main(argv=None) -> dict:
                    f"_train_samples_per_sec_bs{head['batch_size']}"),
         "value": head["samples_per_sec"],
         "unit": "samples/sec",
-        "vs_baseline": head.get("vs_baseline", 0.0),
+        # null (not 0.0) when no reference baseline applies to the headline
+        # model, so consumers don't read "no baseline" as "0x regression"
+        "vs_baseline": head.get("vs_baseline"),
         "device": kind,
         "records": records,
         "best": {"model": best["model"], "batch_size": best["batch_size"],

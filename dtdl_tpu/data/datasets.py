@@ -145,25 +145,46 @@ def download_cifar10(root: str, url: str | None = None,
 _CIFAR_BATCHES = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
 
 
-def _download_locked(root: str, timeout: float = 600.0) -> None:
+def _download_locked(root: str, timeout: float = 600.0,
+                     stale_after: float = 900.0) -> None:
     """download_cifar10 guarded by an exclusive lockfile: the winner
-    fetches, everyone else sharing this filesystem polls for the result."""
+    fetches, everyone else sharing this filesystem polls for the result.
+
+    A lock whose mtime is older than ``stale_after`` is an orphan from a
+    hard-killed process (the finally never ran) — it is removed so later
+    runs neither stall for the full timeout nor silently fall back to
+    synthetic data.
+    """
     import time
     os.makedirs(root, exist_ok=True)
     lock = os.path.join(root, ".cifar10.download.lock")
+
+    def _clear_stale():
+        try:
+            if time.time() - os.path.getmtime(lock) > stale_after:
+                log.warning("removing stale dataset download lock %s", lock)
+                os.unlink(lock)
+        except OSError:
+            pass   # already gone / racing remover
+
+    _clear_stale()
     try:
         fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
     except FileExistsError:
         deadline = time.time() + timeout
         while os.path.exists(lock) and time.time() < deadline:
             time.sleep(1.0)
+            _clear_stale()
         return  # loser: the winner extracted (or failed); caller re-scans
     try:
         os.close(fd)
         if _find_cifar10_dir(root) is None:
             download_cifar10(root)
     finally:
-        os.unlink(lock)
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
 
 
 def _find_cifar10_dir(root: str) -> str | None:
@@ -205,11 +226,13 @@ def load_cifar10(root: str = "./datasets", download: bool = True):
                           type(e).__name__, e)
         barrier("cifar10_download")
         cdir = _find_cifar10_dir(root)
-        if cdir is None and not is_leader():
-            # per-host local disks: the leader's download landed on ITS
-            # filesystem, not ours.  Each remaining process fetches into
-            # its own root, one at a time per root via an exclusive
-            # lockfile (same-host processes share the root).
+        if cdir is None:
+            # still missing: either per-host local disks (the leader's
+            # download landed on ITS filesystem, not ours) or the leader's
+            # fetch failed transiently.  EVERY process — leader included —
+            # retries into its own root, serialized per root by an
+            # exclusive lockfile, so ranks converge on the same outcome
+            # (all real data, or all loudly synthetic).
             try:
                 _download_locked(root)
             except Exception as e:

@@ -200,7 +200,12 @@ class TransformerLM(nn.Module):
         return self.d_model // self.n_heads
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False,
+                 return_hidden: bool = False):
+        """``return_hidden=True`` yields the final normalized hidden states
+        [B, S, D] instead of logits — the contract of the vocab-chunked LM
+        loss (dtdl_tpu/ops/cross_entropy.py:chunked_lm_loss), which never
+        materializes the [B, S, V] logits."""
         del train
         emb = self.param(
             "embed", _part(nn.initializers.normal(stddev=0.02),
@@ -222,6 +227,8 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}")(x, cos, sin)
 
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
         logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(self.dtype))
         return logits.astype(jnp.float32)
 

@@ -1,3 +1,3 @@
 from dtdl_tpu.ops.cross_entropy import (  # noqa: F401
-    softmax_cross_entropy, accuracy,
+    chunked_lm_loss, softmax_cross_entropy, accuracy,
 )

@@ -11,8 +11,11 @@ already optimal; a fused Pallas kernel only pays off at large vocab sizes.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
@@ -35,3 +38,132 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Fraction of argmax predictions matching integer labels."""
     return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32).mean()
+
+
+# ---------------------------------------------------------------------------
+# vocab-chunked LM head loss (never materializes [tokens, vocab] logits)
+# ---------------------------------------------------------------------------
+
+def _ce_chunks(V: int, chunk_size: int) -> tuple[int, int]:
+    vc = min(max(int(chunk_size), 1), V)
+    return -(-V // vc), vc
+
+
+def _vary_like(x, *refs):
+    """pcast ``x`` to carry the union of the refs' varying axes (shard_map
+    VMA typing: scan carries must enter with their steady-state vma)."""
+    have = set(jax.typeof(x).vma or ())
+    want = set()
+    for r in refs:
+        want |= set(jax.typeof(r).vma or ())
+    add = tuple(sorted(want - have))
+    return jax.lax.pcast(x, add, to="varying") if add else x
+
+
+def _chunk_logits(h, emb, c, vc, V):
+    """f32 logits of vocab chunk c: ([T, vc], global col ids, valid mask).
+
+    When the last chunk would run past V the window slides back to keep
+    static shapes; columns already covered by the previous chunk come back
+    with ``valid=False`` and their logits forced to -inf.
+    """
+    start = c * vc
+    base = jnp.minimum(start, V - vc)
+    emb_c = jax.lax.dynamic_slice_in_dim(emb, base, vc, 0)
+    cols = base + jnp.arange(vc)
+    valid = cols >= start
+    logits = jnp.einsum("td,vd->tv", h.astype(jnp.float32),
+                        emb_c.astype(jnp.float32))
+    logits = jnp.where(valid[None, :], logits, -jnp.inf)
+    return logits, cols, valid
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def chunked_lm_loss(h, emb, targets, mask, chunk_size=4096):
+    """Masked-sum LM cross entropy with the vocab dim processed in chunks.
+
+    ``h`` [T, D] final hidden states, ``emb`` [V, D] (tied) output
+    embedding, ``targets`` [T] int32, ``mask`` [T] f32.  Returns
+    ``(loss_sum, correct_sum)`` where correct counts argmax==target hits
+    (masked), so callers get accuracy without logits.
+
+    The flash-attention trick applied to the vocab axis: an online
+    (max, sumexp) recurrence over [T, chunk] logit tiles — peak memory is
+    O(T * chunk) instead of the O(T * V) f32 logits the dense head
+    materializes for itself *and* for its backward residual (at V=32k,
+    seq 4k, batch 8 that is 2 x 4.2 GB).  The backward pass recomputes
+    each tile from the saved (h, lse) — the same recompute-not-store
+    contract as dtdl_tpu/ops/attention.py.
+    """
+    (loss, correct), _ = _chunked_fwd(h, emb, targets, mask, chunk_size)
+    return loss, correct
+
+
+def _chunked_fwd(h, emb, targets, mask, chunk_size):
+    V = emb.shape[0]
+    n, vc = _ce_chunks(V, chunk_size)
+    T = h.shape[0]
+    tgt = targets.astype(jnp.int32)
+
+    def step(carry, c):
+        m, s, true_l, best, arg = carry
+        logits, cols, valid = _chunk_logits(h, emb, c, vc, V)
+        cmax = jnp.max(logits, -1)
+        m_new = jnp.maximum(m, cmax)
+        s = (s * jnp.exp(m - m_new)
+             + jnp.sum(jnp.exp(logits - m_new[:, None]), -1))
+        hit = (tgt[:, None] == cols[None, :]) & valid[None, :]
+        true_l = true_l + jnp.sum(jnp.where(hit, logits, 0.0), -1)
+        carg = cols[jnp.argmax(logits, -1)]
+        arg = jnp.where(cmax > best, carg, arg)
+        best = jnp.maximum(best, cmax)
+        return (m_new, s, true_l, best, arg), None
+
+    neg = _vary_like(jnp.full((T,), -jnp.inf, jnp.float32), h, emb, targets)
+    zero = _vary_like(jnp.zeros((T,), jnp.float32), h, emb, targets)
+    arg0 = _vary_like(jnp.zeros((T,), jnp.int32), h, emb, targets)
+    (m, s, true_l, _, arg), _ = jax.lax.scan(
+        step, (neg, zero, zero, neg, arg0), jnp.arange(n))
+    lse = m + jnp.log(s)
+    loss = jnp.sum((lse - true_l) * mask)
+    correct = jnp.sum((arg == tgt).astype(jnp.float32) * mask)
+    return (loss, correct), (h, emb, targets, mask, lse, true_l)
+
+
+def _chunked_bwd(chunk_size, res, cot):
+    h, emb, targets, mask, lse, true_l = res
+    g = cot[0]                  # cotangent of loss_sum; correct_sum: ignored
+    V, D = emb.shape
+    n, vc = _ce_chunks(V, chunk_size)
+    tgt = targets.astype(jnp.int32)
+    w = (mask * g).astype(jnp.float32)
+
+    def step(dh, c):
+        logits, cols, valid = _chunk_logits(h, emb, c, vc, V)
+        p = jnp.where(valid[None, :], jnp.exp(logits - lse[:, None]), 0.0)
+        onehot = ((tgt[:, None] == cols[None, :]) & valid[None, :]
+                  ).astype(jnp.float32)
+        dl = (p - onehot) * w[:, None]              # [T, vc]
+        base = jnp.minimum(c * vc, V - vc)
+        emb_c = jax.lax.dynamic_slice_in_dim(emb, base, vc, 0)
+        dh = dh + jnp.einsum("tv,vd->td", dl, emb_c.astype(jnp.float32))
+        demb_c = jnp.einsum("tv,td->vd", dl, h.astype(jnp.float32))
+        return dh, (demb_c, base)
+
+    dh0 = _vary_like(jnp.zeros(h.shape, jnp.float32), h, emb, targets, g)
+    dh, (demb_tiles, bases) = jax.lax.scan(step, dh0, jnp.arange(n))
+
+    def add_tile(i, acc):
+        cur = jax.lax.dynamic_slice_in_dim(acc, bases[i], vc, 0)
+        return jax.lax.dynamic_update_slice_in_dim(
+            acc, cur + demb_tiles[i], bases[i], 0)
+
+    demb = jax.lax.fori_loop(
+        0, n, add_tile,
+        _vary_like(jnp.zeros((V, D), jnp.float32), demb_tiles))
+    dmask = (lse - true_l) * g
+    dtargets = np.zeros(targets.shape, jax.dtypes.float0)
+    return dh.astype(h.dtype), demb.astype(emb.dtype), dtargets, dmask
+
+
+chunked_lm_loss.defvjp(_chunked_fwd, _chunked_bwd)

@@ -122,12 +122,21 @@ def make_eval_step(strategy: Strategy | None = None,
     return strategy.compile_eval(evaluate)
 
 
-def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0):
+def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
+                       vocab_chunk_size: int = 0):
     """Compiled causal-LM step ``(state, batch) -> (state, metrics)``.
 
     ``batch``: {'tokens': int32 [B, S]} (optionally 'mask' f32 [B, S-1] over
     *target* positions).  Next-token cross entropy with shift; metrics are
     globally averaged {'loss', 'accuracy'} like the classifier step.
+
+    ``vocab_chunk_size > 0`` switches the head to the vocab-chunked loss
+    (dtdl_tpu/ops/cross_entropy.py:chunked_lm_loss, tiles of
+    ``vocab_chunk_size`` vocab columns): the [B, S, V] logits are never materialized
+    — fwd and bwd stream [tokens, chunk] tiles — so large-vocab models fit
+    at long sequence.  Requires a model whose ``__call__`` accepts
+    ``return_hidden=True`` (TransformerLM does) with a tied ``embed``
+    parameter at the top of its param tree.
     """
     strategy = strategy or SingleDevice()
 
@@ -146,24 +155,38 @@ def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0):
 
         rngs = _dropout_rngs(state, strategy, seed)
 
-        def compute_loss(params):
-            logits = state.apply_fn({"params": params}, inputs, train=True,
-                                    rngs=rngs)
-            logits = logits.astype(jnp.float32)
-            lse = jax.nn.logsumexp(logits, axis=-1)
-            true = jnp.take_along_axis(
-                logits, targets[..., None].astype(jnp.int32), -1)[..., 0]
-            return jnp.sum((lse - true) * mask) * scale, logits
+        if vocab_chunk_size:
+            from dtdl_tpu.ops.cross_entropy import chunked_lm_loss
 
-        (loss, logits), grads = jax.value_and_grad(
+            def compute_loss(params):
+                h = state.apply_fn({"params": params}, inputs, train=True,
+                                   rngs=rngs, return_hidden=True)
+                b, s, d = h.shape
+                emb = params["embed"]
+                if hasattr(emb, "unbox"):   # flax logical-partitioning box
+                    emb = emb.unbox()
+                loss_sum, correct = chunked_lm_loss(
+                    h.reshape(b * s, d), emb,
+                    targets.reshape(b * s), mask.reshape(b * s),
+                    vocab_chunk_size)
+                return loss_sum * scale, correct * scale
+        else:
+            def compute_loss(params):
+                logits = state.apply_fn({"params": params}, inputs,
+                                        train=True, rngs=rngs)
+                logits = logits.astype(jnp.float32)
+                lse = jax.nn.logsumexp(logits, axis=-1)
+                true = jnp.take_along_axis(
+                    logits, targets[..., None].astype(jnp.int32), -1)[..., 0]
+                loss = jnp.sum((lse - true) * mask) * scale
+                correct = (jnp.argmax(logits, -1) == targets)
+                return loss, jnp.sum(correct * mask) * scale
+
+        (loss, acc), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(strategy.localize(state.params))
         grads = strategy.grad_sync(grads)
         new_state = state.apply_gradients(grads=grads, batch_stats=None)
-        correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
-        metrics = strategy.metric_sync({
-            "loss": loss,
-            "accuracy": jnp.sum(correct * mask) * scale,
-        })
+        metrics = strategy.metric_sync({"loss": loss, "accuracy": acc})
         return new_state, metrics
 
     return strategy.compile(step)

@@ -42,6 +42,10 @@ def main():
          choices=["tiny", "small", "base"])
     flag(parser, "--seq-len", type=int, default=128)
     flag(parser, "--attn", default="flash", choices=["flash", "dense"])
+    flag(parser, "--vocab-chunk-size", type=int, default=0,
+         help=">0: vocab-chunked LM loss with tiles of N vocab COLUMNS "
+              "(e.g. 2048) — the [B,S,V] logits are never materialized, "
+              "so large-vocab models fit at long sequence")
     args = parser.parse_args()
 
     if args.dataset != "synthetic_lm":
@@ -69,7 +73,8 @@ def main():
                        jnp.zeros((1, args.seq_len), jnp.int32),
                        optax.adamw(args.lr))
     state = strategy.replicate(state)
-    step = make_lm_train_step(strategy)
+    step = make_lm_train_step(strategy,
+                              vocab_chunk_size=args.vocab_chunk_size)
 
     reporter = Reporter([StdoutSink()])
     global_step = 0
