@@ -146,14 +146,17 @@ _CIFAR_BATCHES = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
 
 
 def _download_locked(root: str, timeout: float = 600.0,
-                     stale_after: float = 900.0) -> None:
+                     stale_after: float = 3600.0) -> None:
     """download_cifar10 guarded by an exclusive lockfile: the winner
     fetches, everyone else sharing this filesystem polls for the result.
 
-    A lock whose mtime is older than ``stale_after`` is an orphan from a
-    hard-killed process (the finally never ran) — it is removed so later
-    runs neither stall for the full timeout nor silently fall back to
-    synthetic data.
+    A lock whose mtime is older than ``stale_after`` (default 1 h — far
+    above any plausible fetch, while pollers give up after ``timeout``) is
+    an orphan from a hard-killed process.  Removal is a rename-then-unlink
+    so exactly one remover wins — a plain check-then-unlink could delete a
+    *fresh* lock re-created between the two calls.  The winner also
+    touches the lock between fetch and extraction, restarting the
+    staleness clock for the (fast) extract phase.
     """
     import time
     os.makedirs(root, exist_ok=True)
@@ -162,10 +165,12 @@ def _download_locked(root: str, timeout: float = 600.0,
     def _clear_stale():
         try:
             if time.time() - os.path.getmtime(lock) > stale_after:
-                log.warning("removing stale dataset download lock %s", lock)
-                os.unlink(lock)
+                victim = f"{lock}.stale.{os.getpid()}.{time.time_ns()}"
+                os.rename(lock, victim)   # atomic: one remover wins
+                os.unlink(victim)
+                log.warning("removed stale dataset download lock %s", lock)
         except OSError:
-            pass   # already gone / racing remover
+            pass   # already gone / lost the rename race
 
     _clear_stale()
     try:
@@ -179,6 +184,7 @@ def _download_locked(root: str, timeout: float = 600.0,
     try:
         os.close(fd)
         if _find_cifar10_dir(root) is None:
+            os.utime(lock)                # restart clock before the fetch
             download_cifar10(root)
     finally:
         try:

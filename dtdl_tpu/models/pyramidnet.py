@@ -80,10 +80,23 @@ class ResidualBlock(nn.Module):
 
 
 class PyramidNet(nn.Module):
-    """Additive PyramidNet for 32x32 inputs (reference pytorch/model.py:53-112)."""
+    """Additive PyramidNet for 32x32 inputs (reference pytorch/model.py:53-112).
+
+    ``channel_align > 1`` rounds every block's channel count UP to that
+    multiple (the reference's additive growth yields 8-misaligned widths —
+    17, 19, 21, ... 286).  Measured on a v5e at bs=256: alignment does
+    **not** change wall-clock (63.8 ms/step both ways) — the MXU already
+    pads misaligned channels internally, so aligning only converts hidden
+    padding into counted FLOPs (45.4% -> 48.2% nominal MFU at identical
+    speed).  The remaining utilization gap is per-op overhead across ~150
+    small-spatial convs (conv fusions run at 351 GB/s / 45% MFU — bound by
+    neither roofline), not channel padding.  Kept as an opt-in for
+    experiments; default 1 is the exact reference-parity model.
+    """
     num_layers: int = 18
     alpha: int = 270
     num_classes: int = 10
+    channel_align: int = 1
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -95,13 +108,17 @@ class PyramidNet(nn.Module):
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype)(x)
 
+        def width(ch: float) -> int:
+            a = self.channel_align
+            return -(-int(round(ch)) // a) * a
+
         # fractional running width with per-block rounding, 17 blocks/stage
         in_ch = 16.0
         for stage_stride in (1, 2, 2):
             stride = stage_stride
             for _ in range(self.num_layers - 1):
                 out_ch = in_ch + addrate
-                x = ResidualBlock(int(round(in_ch)), int(round(out_ch)),
+                x = ResidualBlock(width(in_ch), width(out_ch),
                                   stride, dtype=self.dtype)(x, train=train)
                 in_ch = out_ch
                 stride = 1
@@ -114,7 +131,10 @@ class PyramidNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def pyramidnet(dtype=jnp.float32, num_classes: int = 10) -> PyramidNet:
-    """Factory matching reference pytorch/model.py:115-118 (110 layers, a=270)."""
+def pyramidnet(dtype=jnp.float32, num_classes: int = 10,
+               channel_align: int = 1) -> PyramidNet:
+    """Factory matching reference pytorch/model.py:115-118 (110 layers, a=270).
+
+    ``channel_align=8`` selects the TPU-aligned variant (see PyramidNet)."""
     return PyramidNet(num_layers=18, alpha=270, num_classes=num_classes,
-                      dtype=dtype)
+                      channel_align=channel_align, dtype=dtype)

@@ -50,14 +50,11 @@ def _ce_chunks(V: int, chunk_size: int) -> tuple[int, int]:
 
 
 def _vary_like(x, *refs):
-    """pcast ``x`` to carry the union of the refs' varying axes (shard_map
-    VMA typing: scan carries must enter with their steady-state vma)."""
-    have = set(jax.typeof(x).vma or ())
-    want = set()
-    for r in refs:
-        want |= set(jax.typeof(r).vma or ())
-    add = tuple(sorted(want - have))
-    return jax.lax.pcast(x, add, to="varying") if add else x
+    """shard_map VMA pre-cast for scan carries — delegates to the single
+    implementation (lazy import: dtdl_tpu.parallel pulls in the megatron
+    stack, which itself imports dtdl_tpu.ops)."""
+    from dtdl_tpu.parallel.collectives import pvary_like
+    return pvary_like(x, *refs)
 
 
 def _chunk_logits(h, emb, c, vc, V):
@@ -127,18 +124,19 @@ def _chunked_fwd(h, emb, targets, mask, chunk_size):
     lse = m + jnp.log(s)
     loss = jnp.sum((lse - true_l) * mask)
     correct = jnp.sum((arg == tgt).astype(jnp.float32) * mask)
-    return (loss, correct), (h, emb, targets, mask, lse, true_l)
+    return (loss, correct), (h, emb, targets, mask, lse, true_l, arg)
 
 
 def _chunked_bwd(chunk_size, res, cot):
-    h, emb, targets, mask, lse, true_l = res
-    g = cot[0]                  # cotangent of loss_sum; correct_sum: ignored
+    h, emb, targets, mask, lse, true_l, arg = res
+    g = cot[0]                  # cotangent of loss_sum
     V, D = emb.shape
     n, vc = _ce_chunks(V, chunk_size)
     tgt = targets.astype(jnp.int32)
     w = (mask * g).astype(jnp.float32)
 
-    def step(dh, c):
+    def step(carry, c):
+        dh, demb = carry
         logits, cols, valid = _chunk_logits(h, emb, c, vc, V)
         p = jnp.where(valid[None, :], jnp.exp(logits - lse[:, None]), 0.0)
         onehot = ((tgt[:, None] == cols[None, :]) & valid[None, :]
@@ -148,20 +146,19 @@ def _chunked_bwd(chunk_size, res, cot):
         emb_c = jax.lax.dynamic_slice_in_dim(emb, base, vc, 0)
         dh = dh + jnp.einsum("tv,vd->td", dl, emb_c.astype(jnp.float32))
         demb_c = jnp.einsum("tv,td->vd", dl, h.astype(jnp.float32))
-        return dh, (demb_c, base)
+        # in-place tile accumulate: one pass, no stacked [n, vc, D] copy
+        # (overlap columns of a slid-back last tile contribute zeros)
+        cur = jax.lax.dynamic_slice_in_dim(demb, base, vc, 0)
+        demb = jax.lax.dynamic_update_slice_in_dim(demb, cur + demb_c,
+                                                   base, 0)
+        return (dh, demb), None
 
     dh0 = _vary_like(jnp.zeros(h.shape, jnp.float32), h, emb, targets, g)
-    dh, (demb_tiles, bases) = jax.lax.scan(step, dh0, jnp.arange(n))
-
-    def add_tile(i, acc):
-        cur = jax.lax.dynamic_slice_in_dim(acc, bases[i], vc, 0)
-        return jax.lax.dynamic_update_slice_in_dim(
-            acc, cur + demb_tiles[i], bases[i], 0)
-
-    demb = jax.lax.fori_loop(
-        0, n, add_tile,
-        _vary_like(jnp.zeros((V, D), jnp.float32), demb_tiles))
-    dmask = (lse - true_l) * g
+    demb0 = _vary_like(jnp.zeros((V, D), jnp.float32), h, emb, targets, g)
+    (dh, demb), _ = jax.lax.scan(step, (dh0, demb0), jnp.arange(n))
+    # loss term + the correct_sum output's own mask-cotangent (argmax hits
+    # are piecewise-constant in h/emb, so their grads through correct are 0)
+    dmask = (lse - true_l) * g + (arg == tgt).astype(jnp.float32) * cot[1]
     dtargets = np.zeros(targets.shape, jax.dtypes.float0)
     return dh.astype(h.dtype), demb.astype(emb.dtype), dtargets, dmask
 

@@ -50,3 +50,21 @@ def test_resnet_forward_shapes_odd_input_falls_back():
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     out = model.apply(variables, x, train=False)
     assert out.shape == (1, 10)
+
+
+def test_pyramidnet_channel_align_widths():
+    """channel_align rounds block widths up to the multiple; default 1 is
+    the exact reference-parity width schedule.  A shallow pyramid keeps
+    this fast — the width() rounding under test is depth-independent."""
+    import flax
+    from dtdl_tpu.models.pyramidnet import PyramidNet
+
+    x = jnp.zeros((1, 32, 32, 3))
+    aligned = PyramidNet(num_layers=3, alpha=30, channel_align=8)
+    variables = aligned.init(jax.random.PRNGKey(0), x, train=False)
+    for path, leaf in flax.traverse_util.flatten_dict(
+            variables["params"]).items():
+        if path[-1] == "kernel" and len(leaf.shape) == 4:
+            assert leaf.shape[-1] % 8 == 0 or leaf.shape[-1] == 3, path
+    out = aligned.apply(variables, x, train=False)
+    assert out.shape == (1, 10)

@@ -233,3 +233,21 @@ def test_lm_step_vocab_chunked_under_ddp(devices):
     for a, b in zip(outs["ddp"][1], outs["single"][1]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-4)
+
+
+def test_chunked_lm_loss_correct_sum_mask_grad():
+    """Differentiating the correct_sum output w.r.t. mask matches the dense
+    head's gradient (per-position argmax hits), not silent zeros."""
+    from dtdl_tpu.ops.cross_entropy import chunked_lm_loss
+
+    rng = np.random.default_rng(2)
+    T, D, V = 12, 8, 40
+    h = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+    emb = jnp.asarray(rng.normal(size=(V, D)), jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, V, T), jnp.int32)
+    mask = jnp.ones((T,), jnp.float32)
+
+    g = jax.grad(lambda m: chunked_lm_loss(h, emb, tgt, m, 16)[1])(mask)
+    logits = h @ emb.T
+    want = (jnp.argmax(logits, -1) == tgt).astype(jnp.float32)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(want))
