@@ -150,13 +150,18 @@ def _download_locked(root: str, timeout: float = 600.0,
     """download_cifar10 guarded by an exclusive lockfile: the winner
     fetches, everyone else sharing this filesystem polls for the result.
 
-    A lock whose mtime is older than ``stale_after`` (default 1 h — far
-    above any plausible fetch, while pollers give up after ``timeout``) is
-    an orphan from a hard-killed process.  Removal is a rename-then-unlink
-    so exactly one remover wins — a plain check-then-unlink could delete a
-    *fresh* lock re-created between the two calls.  The winner also
-    touches the lock between fetch and extraction, restarting the
-    staleness clock for the (fast) extract phase.
+    A lock whose mtime is older than ``stale_after`` is an orphan from a
+    hard-killed process.  The 1 h default is the correctness horizon: it
+    must exceed the worst-case fetch+extract (the lock's mtime is set once,
+    at acquisition), while pollers give up after ``timeout`` (10 min) —
+    so in the overlap window a very slow but live download could in
+    principle be reaped.  Removal goes through rename-then-unlink, which
+    narrows (but does not close) the check-to-remove race against a fresh
+    lock re-created at the same path; with a >1 h staleness horizon the
+    remaining exposure needs two removers to both observe hour-stale state
+    around the instant of re-creation.  Accepted: the fallout is a
+    duplicate download attempt, and the checksum + atomic extract keep the
+    result correct.
     """
     import time
     os.makedirs(root, exist_ok=True)
@@ -166,7 +171,7 @@ def _download_locked(root: str, timeout: float = 600.0,
         try:
             if time.time() - os.path.getmtime(lock) > stale_after:
                 victim = f"{lock}.stale.{os.getpid()}.{time.time_ns()}"
-                os.rename(lock, victim)   # atomic: one remover wins
+                os.rename(lock, victim)   # narrows (not closes) the race
                 os.unlink(victim)
                 log.warning("removed stale dataset download lock %s", lock)
         except OSError:
@@ -184,7 +189,6 @@ def _download_locked(root: str, timeout: float = 600.0,
     try:
         os.close(fd)
         if _find_cifar10_dir(root) is None:
-            os.utime(lock)                # restart clock before the fetch
             download_cifar10(root)
     finally:
         try:

@@ -1,0 +1,69 @@
+"""Child script for launcher tests: 2-process 4D-parallel megatron step.
+
+The 'data' mesh axis spans the process (DCN) boundary while 'pipe' and
+'model' stay process-local — the standard multi-host placement.  Exercises
+the full 4D step (interleaved 1F1B + routed MoE) across a real process
+boundary: gradient reduction over 'data' crosses hosts, the pipeline and
+tensor collectives stay inside each host's device set.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import optax
+
+from dtdl_tpu.runtime import initialize
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--coordinator", default="")
+parser.add_argument("--num-processes", type=int, default=1)
+parser.add_argument("--process-id", type=int, default=0)
+args = parser.parse_args()
+
+initialize(args.coordinator, args.num_processes, args.process_id)
+assert jax.process_count() == args.num_processes
+
+from dtdl_tpu.parallel import megatron as M
+from dtdl_tpu.runtime.mesh import build_mesh
+
+mesh = build_mesh(shape=(2, 1, 2, 2), axes=M.AXES)
+cfg = M.MegatronConfig(
+    vocab_size=64, d_model=32, n_heads=4, d_ff=64,
+    n_stages=2, layers_per_stage=2, virtual_stages=2,
+    n_experts=4, moe_dispatch="routed", max_seq=32,
+    n_microbatches=2, dtype=np.float32)
+
+params = M.place_params(mesh, cfg, M.init_params(cfg, jax.random.PRNGKey(0)))
+opt = optax.sgd(0.1)
+opt_state = M.init_optimizer(cfg, mesh, opt, params)
+step = M.make_megatron_train_step(cfg, mesh, opt)
+
+# identical global batch on every process; each passes its local 'data' rows
+rng = np.random.default_rng(0)
+B, S = 8, 32
+full = {
+    "tokens": rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32),
+    "targets": rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32),
+    "mask": np.ones((B, S), np.float32),
+}
+half = B // 2
+pid = jax.process_index()
+local = {k: v[pid * half:(pid + 1) * half] for k, v in full.items()}
+batch = M.shard_lm_batch(mesh, local)
+
+params, opt_state, loss, metrics = step(
+    params, opt_state, batch["tokens"], batch["targets"], batch["mask"])
+loss = float(loss)
+assert np.isfinite(loss)
+drop = float(metrics["moe_dropped_frac"])
+
+leaf = jax.tree.leaves(params)[0]
+local_digest = float(sum(
+    np.abs(np.asarray(sh.data)).sum() for sh in leaf.addressable_shards))
+print(f"RESULT4D process={jax.process_index()} loss={loss:.6f} "
+      f"dropped={drop:.4f} digest={local_digest:.6f}", flush=True)

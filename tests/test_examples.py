@@ -67,11 +67,14 @@ def test_train_mnist_example_with_resume(tmp_path):
               "-o", out_dir]
     out = run_example("train_mnist.py", "-e", "2", *common)
     assert "val_accuracy" in out
-    snaps = [d for d in os.listdir(out_dir) if d.startswith("snapshot_")]
+    # snapshot dirs only — snapshot_N.meta.json sidecars are not resumable
+    snaps = [d for d in os.listdir(out_dir)
+             if re.fullmatch(r"snapshot_\d+", d)]
     assert snaps, os.listdir(out_dir)
+    latest = max(snaps, key=lambda d: int(d.split("_")[1]))
     # resume from the snapshot into a longer run
     out2 = run_example("train_mnist.py", "-e", "3", "-r",
-                       os.path.join(out_dir, sorted(snaps)[-1]), *common)
+                       os.path.join(out_dir, latest), *common)
     assert "val_accuracy" in out2
 
 
