@@ -89,7 +89,7 @@ def bench_one(model_name: str, batch_size: int, warmup: int = 10,
 
     strategy = choose_strategy("auto")
     if model_name == "resnet50":
-        model = resnet50(dtype=jnp.bfloat16)
+        model = resnet50(dtype=jnp.bfloat16, s2d_stem=True)
         shape, classes = (224, 224, 3), 1000
         sample_budget = sample_budget or 4096
     else:

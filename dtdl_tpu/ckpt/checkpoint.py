@@ -50,9 +50,44 @@ def save_weights(path: str, tree) -> str:
 
 
 def load_weights(path: str, like):
-    """Load weights saved by `save_weights` into the structure of ``like``."""
+    """Load weights saved by `save_weights` into the structure of ``like``.
+
+    **Shape-validated**: flax ``from_bytes`` happily returns the *stored*
+    array when its shape differs from ``like``'s (verified: a (256,8,32)
+    blob restores into a (256,2,128) slot unchanged), which would let a
+    checkpoint from a differently-configured model load and then compute a
+    different function or crash far from the cause.  Any leaf whose shape
+    disagrees with ``like`` fails loudly here instead, naming the paths —
+    e.g. snapshots predating a named-config geometry change (the round-3
+    head_dim-128 'small'/'base' presets) cannot silently load.
+    """
     with open(path, "rb") as f:
-        return serialization.from_bytes(like, f.read())
+        restored = serialization.from_bytes(like, f.read())
+    _validate_shapes(restored, like, path)
+    return restored
+
+
+def _validate_shapes(restored, like, origin: str) -> None:
+    """Raise when any restored leaf's shape disagrees with ``like``'s.
+
+    Neither flax ``from_bytes`` nor orbax ``StandardCheckpointer.restore``
+    enforces this (both verified to hand back the *stored* shape when it
+    differs from the target), so a checkpoint from a differently-configured
+    model would load and then compute a different function or crash far
+    from the cause."""
+    bad = []
+    for (path_r, leaf_r), (_, leaf_l) in zip(
+            jax.tree_util.tree_leaves_with_path(restored),
+            jax.tree_util.tree_leaves_with_path(like)):
+        want = getattr(leaf_l, "shape", None)
+        got = getattr(leaf_r, "shape", None)
+        if want is not None and got is not None and want != got:
+            bad.append(f"{jax.tree_util.keystr(path_r)}: "
+                       f"checkpoint {got} vs model {want}")
+    if bad:
+        raise ValueError(
+            f"checkpoint {origin} does not match the model architecture "
+            f"({len(bad)} mismatched leaves):\n  " + "\n  ".join(bad[:10]))
 
 
 class Checkpointer:
@@ -72,6 +107,7 @@ class Checkpointer:
         self.directory = directory
         self.keep = keep
         self._ocp = None   # lazy, persistent AsyncCheckpointer
+        self._last_saved_step = None   # protected from gc until superseded
         if is_leader():
             os.makedirs(directory, exist_ok=True)
         barrier("ckpt_mkdir")
@@ -89,9 +125,16 @@ class Checkpointer:
         return self._ocp
 
     def wait_until_finished(self) -> None:
-        """Block until every in-flight async snapshot is durable on disk."""
+        """Block until every in-flight async snapshot is durable on disk,
+        then trim to ``keep`` — the just-finalized snapshot is visible now,
+        so this is the point where the oldest retained one becomes excess.
+        The last-saved step stays protected: after a rollback-restore, a
+        re-save of an old step (which sorts below newer snapshots) must not
+        be deleted the moment it lands."""
         if self._ocp is not None:
             self._ocp.wait_until_finished()
+            self._gc(self._SNAP_RE, "snapshot_{}",
+                     protect=self._last_saved_step)
 
     def close(self) -> None:
         if self._ocp is not None:
@@ -104,7 +147,8 @@ class Checkpointer:
         path = os.path.join(self.directory,
                             f"weights_epoch_{epoch:04d}.msgpack")
         save_weights(path, params)
-        self._gc(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack")
+        self._gc(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack",
+                 protect=epoch)
         return path
 
     def latest_weights(self, like):
@@ -132,9 +176,17 @@ class Checkpointer:
         path = os.path.abspath(
             os.path.join(self.directory, f"snapshot_{step}"))
         self._checkpointer.save(path, state, force=True)
+        self._last_saved_step = step
+        # The async save is only *staged* here: the snapshot dir still has
+        # its orbax tmp name and _list can't see it.  Trimming over the
+        # DURABLE list only (never counting the in-flight step as present)
+        # keeps `keep` durable snapshots intact through the write window —
+        # a crash mid-write can never leave fewer.  The now-excess oldest
+        # one is removed at wait_until_finished, once the new snapshot is
+        # durable and visible.
+        self._gc(self._SNAP_RE, "snapshot_{}", protect=step)
         if wait:
-            self._checkpointer.wait_until_finished()
-        self._gc(self._SNAP_RE, "snapshot_{}")
+            self.wait_until_finished()
         return path
 
     def restore(self, like, step: int | None = None):
@@ -150,7 +202,9 @@ class Checkpointer:
         step = max(steps) if step is None else step
         path = os.path.abspath(
             os.path.join(self.directory, f"snapshot_{step}"))
-        return self._checkpointer.restore(path, like), step
+        restored = self._checkpointer.restore(path, like)
+        _validate_shapes(restored, like, path)
+        return restored, step
 
     def latest_step(self) -> int | None:
         """Step of the newest full-state snapshot (None when none exist)."""
@@ -161,7 +215,9 @@ class Checkpointer:
     def restore_path(self, like, path: str):
         """Restore from an explicit snapshot path (--resume <path>)."""
         self.wait_until_finished()
-        return self._checkpointer.restore(os.path.abspath(path), like)
+        restored = self._checkpointer.restore(os.path.abspath(path), like)
+        _validate_shapes(restored, like, path)
+        return restored
 
     # -- shape 1: final weights ----------------------------------------------
 
@@ -181,12 +237,17 @@ class Checkpointer:
                 out.append(int(m.group(1)))
         return sorted(out)
 
-    def _gc(self, regex, fmt) -> None:
+    def _gc(self, regex, fmt, protect: int | None = None) -> None:
+        """Remove all but the ``keep`` newest entries.  ``protect`` (the id
+        just saved) is never a victim even when it sorts low — re-saving an
+        old step must not delete that step's own snapshot."""
         if self.keep is None or not is_leader():
             return
         import shutil
         ids = self._list(regex)
         for old in ids[:-self.keep]:
+            if old == protect:
+                continue
             victim = os.path.join(self.directory, fmt.format(old))
             if os.path.isdir(victim):
                 shutil.rmtree(victim)

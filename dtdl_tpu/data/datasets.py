@@ -145,52 +145,94 @@ def download_cifar10(root: str, url: str | None = None,
 _CIFAR_BATCHES = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
 
 
-def _download_locked(root: str, timeout: float = 600.0,
-                     stale_after: float = 3600.0) -> None:
+def _download_locked(root: str, heartbeat: float = 15.0,
+                     stale_after: float = 120.0) -> None:
     """download_cifar10 guarded by an exclusive lockfile: the winner
     fetches, everyone else sharing this filesystem polls for the result.
 
-    A lock whose mtime is older than ``stale_after`` is an orphan from a
-    hard-killed process.  The 1 h default is the correctness horizon: it
-    must exceed the worst-case fetch+extract (the lock's mtime is set once,
-    at acquisition), while pollers give up after ``timeout`` (10 min) —
-    so in the overlap window a very slow but live download could in
-    principle be reaped.  Removal goes through rename-then-unlink, which
-    narrows (but does not close) the check-to-remove race against a fresh
-    lock re-created at the same path; with a >1 h staleness horizon the
-    remaining exposure needs two removers to both observe hour-stale state
-    around the instant of re-creation.  Accepted: the fallout is a
-    duplicate download attempt, and the checksum + atomic extract keep the
-    result correct.
+    **Liveness, not a wall clock**: the winner touches the lock's mtime
+    every ``heartbeat`` seconds from a daemon thread, and pollers wait for
+    as long as they keep *observing the mtime change* (judged against a
+    local monotonic clock, so cross-host clock skew and NFS attribute-cache
+    lag cannot make a live lock look stale) — a live download can
+    legitimately run for hours and every rank still converges on the same
+    real dataset (no poller ever gives up on a live winner and silently
+    trains on synthetic data while the winner trains on real CIFAR-10).
+    Only a lock whose heartbeat has stopped for ``stale_after`` of local
+    observation (a hard-killed owner) is reaped.  Every poller exit —
+    winner finished, lock reaped here or by a peer — loops back into
+    acquisition, where the already-downloaded check under the lock decides
+    whether any work remains: a transiently-vanished lock can never strand
+    one rank on the synthetic fallback while its peers get real data.
+    Reap removal goes through rename-then-unlink, which narrows (but does
+    not close) the check-to-remove race against a fresh lock re-created at
+    the same path; the fallout of losing that race is a duplicate download
+    attempt, and the checksum + atomic extract keep the result correct.
     """
+    import threading
     import time
     os.makedirs(root, exist_ok=True)
     lock = os.path.join(root, ".cifar10.download.lock")
 
-    def _clear_stale():
+    def _reap():
         try:
-            if time.time() - os.path.getmtime(lock) > stale_after:
-                victim = f"{lock}.stale.{os.getpid()}.{time.time_ns()}"
-                os.rename(lock, victim)   # narrows (not closes) the race
-                os.unlink(victim)
-                log.warning("removed stale dataset download lock %s", lock)
+            victim = f"{lock}.stale.{os.getpid()}.{time.time_ns()}"
+            os.rename(lock, victim)   # narrows (not closes) the race
+            os.unlink(victim)
+            log.warning("removed stale dataset download lock %s", lock)
         except OSError:
             pass   # already gone / lost the rename race
 
-    _clear_stale()
-    try:
-        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-    except FileExistsError:
-        deadline = time.time() + timeout
-        while os.path.exists(lock) and time.time() < deadline:
+    while True:
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            break   # winner
+        except FileExistsError:
+            pass
+        # Loser: poll while the winner's heartbeat keeps the lock's mtime
+        # *changing*.  Staleness is judged by locally-observed mtime change
+        # against a local monotonic clock — never by (now - mtime), which
+        # compares this host's wall clock against an mtime stamped by the
+        # winner's host (cross-host clock skew or NFS attribute-cache lag
+        # would reap a live lock).  The cost: an orphan lock takes
+        # ``stale_after`` of observation before it is reaped.
+        last_mtime = None
+        last_change = time.monotonic()
+        stale = False
+        while True:
+            try:
+                m = os.path.getmtime(lock)
+            except OSError:
+                break   # lock vanished: winner finished OR another poller
+                        # reaped it — re-enter acquisition; a finished
+                        # download is caught under the lock (dir re-scan)
+            if m != last_mtime:
+                last_mtime, last_change = m, time.monotonic()
+            elif time.monotonic() - last_change > stale_after:
+                stale = True
+                break   # heartbeat stopped: hard-killed owner
             time.sleep(1.0)
-            _clear_stale()
-        return  # loser: the winner extracted (or failed); caller re-scans
+        if stale:
+            _reap()
+        continue    # retry acquisition; the dataset check below decides
+                    # whether any downloading is actually left to do
+    stop = threading.Event()
+
+    def _beat():
+        while not stop.wait(heartbeat):
+            try:
+                os.utime(lock)
+            except OSError:
+                return
+    beater = threading.Thread(target=_beat, daemon=True)
+    beater.start()
     try:
         os.close(fd)
         if _find_cifar10_dir(root) is None:
             download_cifar10(root)
     finally:
+        stop.set()
+        beater.join()
         try:
             os.unlink(lock)
         except OSError:

@@ -90,10 +90,16 @@ class SpaceToDepthStem(nn.Module):
 
 
 class ResNet(nn.Module):
+    """``s2d_stem`` is **opt-in** (like PyramidNet's ``channel_align``): it
+    renames the stem parameter path (``SpaceToDepthStem_0/kernel`` vs
+    ``Conv_0/kernel``), so flipping it silently breaks restore of any
+    snapshot taken with the other setting.  The default keeps the canonical
+    checkpoint tree interchangeable with reference-format weight ports; the
+    bench path enables it explicitly for the HBM win."""
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     num_classes: int = 1000
     dtype: Any = jnp.float32
-    s2d_stem: bool = True
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -120,5 +126,6 @@ class ResNet(nn.Module):
 ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
 
 
-def resnet50(dtype=jnp.float32, num_classes: int = 1000) -> ResNet:
-    return ResNet50(num_classes=num_classes, dtype=dtype)
+def resnet50(dtype=jnp.float32, num_classes: int = 1000,
+             s2d_stem: bool = False) -> ResNet:
+    return ResNet50(num_classes=num_classes, dtype=dtype, s2d_stem=s2d_stem)

@@ -241,6 +241,14 @@ def transformer_lm(size: str = "tiny", **overrides) -> TransformerLM:
     of every matmul lane — measured 2.6x slower end-to-end on a v5e at seq
     4096 (397k vs 1,037k tokens/s for the identical FLOP count).  Fewer,
     wider heads is the TPU-first layout.
+
+    These are the *v2* geometries (the canonical names 'small-hd128' /
+    'base-hd128' alias them): pre-hd128 'small'/'base' snapshots carry
+    differently-shaped attention kernels, so an old checkpoint cannot
+    silently load into the new head split — both the msgpack weight path
+    (`ckpt.load_weights`) and the orbax full-state path
+    (`Checkpointer.restore`/`restore_path`) run explicit shape validation
+    and reject the mismatch (neither flax's nor orbax's own restore does).
     """
     cfgs = {
         "tiny": dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
@@ -250,6 +258,8 @@ def transformer_lm(size: str = "tiny", **overrides) -> TransformerLM:
         "base": dict(vocab_size=32000, d_model=512, n_layers=8, n_heads=4,
                      d_ff=1408, max_seq=2048),
     }
+    cfgs["small-hd128"] = cfgs["small"]
+    cfgs["base-hd128"] = cfgs["base"]
     cfg = dict(cfgs[size])
     cfg.update(overrides)
     return TransformerLM(**cfg)

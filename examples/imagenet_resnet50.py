@@ -50,6 +50,10 @@ def main():
     flag(parser, "--log-interval", type=int, default=20)
     flag(parser, "--dtype", default="bfloat16",
          choices=["bfloat16", "float32"])
+    flag(parser, "--s2d-stem", action="store_true",
+         help="space-to-depth stem (faster on TPU; renames the stem param "
+              "path, so snapshots are not interchangeable with the "
+              "standard-stem tree)")
     flag(parser, "--seed", type=int, default=0)
     add_data_flags(parser, dataset="synthetic")
     add_topology_flags(parser)
@@ -62,7 +66,8 @@ def main():
 
     model = resnet50(num_classes=args.num_classes,
                      dtype=jnp.bfloat16 if args.dtype == "bfloat16"
-                     else jnp.float32)
+                     else jnp.float32,
+                     s2d_stem=args.s2d_stem)
     base = args.lr * args.batch_size / 256  # linear scaling rule
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, base, args.warmup_steps, max(args.steps, args.warmup_steps + 1))

@@ -243,3 +243,55 @@ def test_cifar10_partial_extraction_self_repairs(tmp_path):
     datasets.download_cifar10(str(root), url=tgz.as_uri(), md5=md5)
     (tr_i, _), _ = datasets.load_cifar10(str(root), download=False)
     assert tr_i.shape == (100, 32, 32, 3)
+
+
+def test_download_lock_waits_for_live_winner(tmp_path, monkeypatch):
+    """A poller never abandons a live winner: it waits while the lock's
+    heartbeat keeps changing the mtime and proceeds as soon as the lock is
+    released — no wall-clock deadline that could fall back to synthetic
+    data mid-download.  After the release it re-checks under the lock and
+    finds the winner's result, so it downloads nothing itself."""
+    import threading
+    import time
+
+    import dtdl_tpu.data.datasets as ds
+
+    root = str(tmp_path)
+    lock = tmp_path / ".cifar10.download.lock"
+    lock.touch()
+
+    def release_soon():
+        time.sleep(2.0)
+        lock.unlink()
+    t = threading.Thread(target=release_soon)
+    t.start()
+    calls = []
+    monkeypatch.setattr(ds, "_find_cifar10_dir", lambda r: str(tmp_path))
+    monkeypatch.setattr(ds, "download_cifar10", lambda r: calls.append(r))
+    t0 = time.monotonic()
+    ds._download_locked(root, heartbeat=0.5, stale_after=30.0)
+    elapsed = time.monotonic() - t0
+    t.join()
+    assert elapsed >= 1.5, "poller returned while the lock was live"
+    assert calls == [], "winner's result was there; no re-download"
+    assert not lock.exists(), "poller's own acquisition must release"
+
+
+def test_download_lock_reaps_dead_winner_and_takes_over(tmp_path,
+                                                        monkeypatch):
+    """A lock whose heartbeat stopped (hard-killed owner) is reaped — after
+    ``stale_after`` of locally-observed mtime silence, independent of any
+    cross-host clock — and the reaper acquires the lock itself instead of
+    giving up."""
+    import dtdl_tpu.data.datasets as ds
+
+    root = str(tmp_path)
+    lock = tmp_path / ".cifar10.download.lock"
+    lock.touch()   # mtime will never change again: dead owner
+
+    calls = []
+    monkeypatch.setattr(ds, "_find_cifar10_dir", lambda r: None)
+    monkeypatch.setattr(ds, "download_cifar10", lambda r: calls.append(r))
+    ds._download_locked(root, heartbeat=0.5, stale_after=2.0)
+    assert calls == [root], "reaper should have downloaded itself"
+    assert not lock.exists()
