@@ -145,18 +145,43 @@ def bench_one(model_name: str, batch_size: int, warmup: int = 10,
     return row
 
 
-def bench_lm(batch_size: int = 8, seq: int = 4096, warmup: int = 5,
-             iters: int = 30) -> dict:
-    """Causal-LM train step ('small' TransformerLM, Pallas flash attention,
-    bf16) — the long-context workload (same config as the README's
-    tokens/sec table).  Reports tokens/sec + MFU."""
+def lm_analytic_flops(cfg, batch: int, seq: int) -> float:
+    """Matmul-only model FLOPs for one LM train step (fwd + 2x bwd).
+
+    XLA's ``cost_analysis()`` cannot see inside Pallas custom-calls, so it
+    misses the flash-attention FLOPs entirely (measured on 'base'
+    bs=8/seq=4096: 8.3e12 reported vs 11.6e12 analytic — the 3.2e12 gap is
+    exactly the attention matmuls; see LM_ROOFLINE.md).  The analytic count
+    is the honest MFU numerator.  Causal attention is counted at the
+    *computed half* (the kernel skips above-diagonal tiles) — conservative
+    vs quoting dense S^2 work — and the backward pass is counted at 2x
+    forward (the standard model-FLOPs convention; the kernel's recompute
+    overhead is deliberately NOT credited)."""
+    t = seq - 1
+    qkvo = 4 * 2 * batch * t * cfg.d_model * (cfg.n_heads * cfg.head_dim)
+    attn = 2 * 2 * batch * cfg.n_heads * t * t * cfg.head_dim * 0.5
+    mlp = 3 * 2 * batch * t * cfg.d_model * cfg.d_ff
+    head = 2 * batch * t * cfg.d_model * cfg.vocab_size
+    fwd = cfg.n_layers * (qkvo + attn + mlp) + head
+    return 3.0 * fwd
+
+
+def bench_lm(batch_size: int = 8, seq: int = 4096, size: str = "base",
+             warmup: int = 5, iters: int = 30) -> dict:
+    """Causal-LM train step (TransformerLM, Pallas flash attention, bf16)
+    — the long-context workload (same configs as the README's tokens/sec
+    table).  Reports tokens/sec + MFU.
+
+    ``mfu`` uses the analytic model-FLOP count (`lm_analytic_flops`);
+    ``mfu_xla`` keeps the raw cost_analysis number, which understates the
+    step because Pallas kernel FLOPs are invisible to it."""
     import optax as _optax
     from dtdl_tpu.models import transformer_lm
     from dtdl_tpu.parallel import choose_strategy
     from dtdl_tpu.train import init_state, make_lm_train_step
 
     strategy = choose_strategy("auto")
-    model = transformer_lm("small", max_seq=seq)
+    model = transformer_lm(size, max_seq=seq)
     tx = _optax.adamw(3e-4)
     state = strategy.replicate(init_state(
         model, jax.random.PRNGKey(0),
@@ -168,7 +193,8 @@ def bench_lm(batch_size: int = 8, seq: int = 4096, warmup: int = 5,
             rng.integers(0, model.vocab_size, (batch_size, seq)), jnp.int32),
     }) for _ in range(4)]
     compiled = step.lower(state, batches[0]).compile()
-    flops_per_step = _flops_of(compiled)
+    xla_flops = _flops_of(compiled)
+    flops_per_step = lm_analytic_flops(model, batch_size, seq)
 
     for i in range(warmup):
         state, metrics = compiled(state, batches[i % len(batches)])
@@ -183,19 +209,21 @@ def bench_lm(batch_size: int = 8, seq: int = 4096, warmup: int = 5,
     tokens_per_sec = batch_size * (seq - 1) * iters / dt
     row = {
         "model": "lm",
+        "size": size,
         "batch_size": batch_size,
         "seq": seq,
         "tokens_per_sec": round(tokens_per_sec, 0),
         "samples_per_sec": round(batch_size * iters / dt, 2),
         "step_time_ms": round(1e3 * dt / iters, 3),
+        "flops_per_step": flops_per_step,
+        "flops_source": "analytic",
+        "achieved_tflops": round(flops_per_step * iters / dt / 1e12, 2),
     }
     peak = peak_flops_per_chip()
-    if flops_per_step:
-        achieved = flops_per_step * iters / dt
-        row["flops_per_step"] = flops_per_step
-        row["achieved_tflops"] = round(achieved / 1e12, 2)
-        if peak:
-            row["mfu"] = round(achieved / peak, 4)
+    if peak:
+        row["mfu"] = round(flops_per_step * iters / dt / peak, 4)
+        if xla_flops:
+            row["mfu_xla"] = round(xla_flops * iters / dt / peak, 4)
     return row
 
 
@@ -204,9 +232,13 @@ _SWEEP = {
     "pyramidnet": (64, 256, 1024),
     # north-star model (BASELINE.json): ImageNet shapes
     "resnet50": (64, 256),
-    # long-context causal LM (flash attention): bs at seq 4096
+    # long-context causal LM (flash attention) at seq 4096: 'small' is the
+    # throughput row (1.1M tok/s), 'base' the MFU row (d_model 512 feeds
+    # the MXU properly — see LM_ROOFLINE.md)
     "lm": (8,),
 }
+
+_LM_SIZES = ("small", "base")
 
 
 def main(argv=None) -> dict:
@@ -239,15 +271,21 @@ def main(argv=None) -> dict:
           file=sys.stderr, flush=True)
 
     records = []
+    # --quick keeps its one-config contract: a single LM size, not the pair
+    lm_sizes = (_LM_SIZES[:1] if a.quick else _LM_SIZES)
     for model_name, bs in configs:
-        try:
-            row = (bench_lm(bs) if model_name == "lm"
-                   else bench_one(model_name, bs))
-        except Exception as e:  # e.g. OOM at a large batch — record, continue
-            row = {"model": model_name, "batch_size": bs,
-                   "error": f"{type(e).__name__}: {e}"[:200]}
-        records.append(row)
-        print("  " + json.dumps(row), file=sys.stderr, flush=True)
+        sizes = lm_sizes if model_name == "lm" else (None,)
+        for size in sizes:
+            try:
+                row = (bench_lm(bs, size=size) if model_name == "lm"
+                       else bench_one(model_name, bs))
+            except Exception as e:  # e.g. OOM at a large batch — record it
+                row = {"model": model_name, "batch_size": bs,
+                       "error": f"{type(e).__name__}: {e}"[:200]}
+                if size:
+                    row["size"] = size
+            records.append(row)
+            print("  " + json.dumps(row), file=sys.stderr, flush=True)
 
     ok = [r for r in records if "samples_per_sec" in r]
     # headline = the best-MFU row of the reference-parity model (pyramidnet),
@@ -266,7 +304,8 @@ def main(argv=None) -> dict:
 
     best = max(ok, key=lambda r: r["samples_per_sec"])
     names = {"pyramidnet": "pyramidnet110_cifar10",
-             "resnet50": "resnet50_imagenet", "lm": "lm_small_seq4096"}
+             "resnet50": "resnet50_imagenet",
+             "lm": f"lm_{head.get('size', 'small')}_seq{head.get('seq')}"}
     result = {
         "metric": (f"{names[head['model']]}"
                    f"_train_samples_per_sec_bs{head['batch_size']}"),
@@ -290,10 +329,13 @@ def main(argv=None) -> dict:
             result["resnet50_mfu"] = rbest["mfu"]
     lm = [r for r in ok if r["model"] == "lm"]
     if lm:
+        # throughput and MFU headline may come from different LM sizes
+        # ('small' wins tokens/sec, 'base' wins MFU) — report each best
         lbest = max(lm, key=lambda r: r.get("tokens_per_sec", 0))
         result["lm_tokens_per_sec"] = lbest.get("tokens_per_sec")
-        if "mfu" in lbest:
-            result["lm_mfu"] = lbest["mfu"]
+        with_mfu = [r for r in lm if "mfu" in r]
+        if with_mfu:
+            result["lm_mfu"] = max(r["mfu"] for r in with_mfu)
     print(json.dumps(result), flush=True)
     return result
 

@@ -68,13 +68,15 @@ def load_weights(path: str, like):
 
 
 def _validate_shapes(restored, like, origin: str) -> None:
-    """Raise when any restored leaf's shape disagrees with ``like``'s.
+    """Raise when any restored leaf's shape or dtype disagrees with
+    ``like``'s.
 
     Neither flax ``from_bytes`` nor orbax ``StandardCheckpointer.restore``
     enforces this (both verified to hand back the *stored* shape when it
     differs from the target), so a checkpoint from a differently-configured
     model would load and then compute a different function or crash far
-    from the cause."""
+    from the cause.  Dtype counts too: a same-shape f32 checkpoint loading
+    into a bf16 run would silently train in the wrong precision."""
     bad = []
     for (path_r, leaf_r), (_, leaf_l) in zip(
             jax.tree_util.tree_leaves_with_path(restored),
@@ -84,6 +86,12 @@ def _validate_shapes(restored, like, origin: str) -> None:
         if want is not None and got is not None and want != got:
             bad.append(f"{jax.tree_util.keystr(path_r)}: "
                        f"checkpoint {got} vs model {want}")
+            continue
+        want_dt = getattr(leaf_l, "dtype", None)
+        got_dt = getattr(leaf_r, "dtype", None)
+        if want_dt is not None and got_dt is not None and want_dt != got_dt:
+            bad.append(f"{jax.tree_util.keystr(path_r)}: checkpoint dtype "
+                       f"{got_dt} vs model {want_dt}")
     if bad:
         raise ValueError(
             f"checkpoint {origin} does not match the model architecture "
@@ -130,11 +138,16 @@ class Checkpointer:
         so this is the point where the oldest retained one becomes excess.
         The last-saved step stays protected: after a rollback-restore, a
         re-save of an old step (which sorts below newer snapshots) must not
-        be deleted the moment it lands."""
+        be deleted the moment it lands.  Trimming happens ONLY when this
+        process actually saved something — read-only paths (restore /
+        latest_step in a fresh process) must never delete snapshots, e.g.
+        an explicit-step rollback restore of the oldest retained snapshot.
+        """
         if self._ocp is not None:
             self._ocp.wait_until_finished()
-            self._gc(self._SNAP_RE, "snapshot_{}",
-                     protect=self._last_saved_step)
+            if self._last_saved_step is not None:
+                self._gc(self._SNAP_RE, "snapshot_{}",
+                         protect=self._last_saved_step)
 
     def close(self) -> None:
         if self._ocp is not None:

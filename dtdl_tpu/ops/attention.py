@@ -399,19 +399,23 @@ def _legal_block(seq: int, block: int) -> int:
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
-                    block_q: int = 512, block_k: int = 512):
+                    block_q: int = 1024, block_k: int = 1024):
     """Flash attention over [batch, heads, seq, head_dim] tensors.
 
     Differentiable (custom VJP, recompute-based backward); O(seq) memory.
     Falls back to the Pallas interpreter off-TPU so CPU tests run the same
     kernel code.
 
-    Default 512x512 blocks: measured on TPU v5e (B=8, H=8, D=64, bf16,
-    fwd+bwd vs XLA dense attention) they give 1.1x at seq 1k, 3.4x at 4k,
-    27x at 8k, while 128x128 blocks lose to XLA below 4k (grid/DMA overhead
-    dominates).  VMEM per step ~= bq*bk*4 (score tile) + bq*d*4 (acc) — 1.2
-    MB at 512/512/d=64, comfortably inside a core's VMEM; 2048x2048 fails
-    to fit.
+    Default 1024x1024 blocks, from a v5e block sweep at the bench headline
+    geometry (B=8, H=4, D=128, seq 4096, bf16, fwd+bwd): 8.2 ms vs 11.5 ms
+    for the old 512x512 default (1.38x; 50 vs 36 useful TFLOP/s) — bigger
+    tiles amortize the bwd recompute's grid/DMA overhead.  The next size up
+    is past the knee: 1024x2048 is 9.1 ms and 2048-row blocks fail to
+    compile (VMEM).  At D=64/H=8 the sweep gives 1024x1024 a smaller edge
+    (17.0 vs 17.9 ms), so one default serves both geometries; earlier
+    small-block data (128x128 losing to XLA dense below seq 4k from
+    grid/DMA overhead) still holds.  VMEM per step ~= bq*bk*4 (score tile)
+    + bq*d*4 (acc): 4.5 MB at 1024/1024/d=128.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]

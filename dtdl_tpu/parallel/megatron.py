@@ -799,6 +799,30 @@ def init_optimizer(cfg: MegatronConfig, mesh: Mesh, optimizer, params):
         state, opt_state_specs(cfg, optimizer))
 
 
+def abstract_state(cfg: MegatronConfig, mesh: Mesh, optimizer):
+    """Sharded abstract ``(params, opt_state)`` — the orbax restore target.
+
+    Each leaf is a ShapeDtypeStruct carrying the NamedSharding from
+    :func:`param_specs` / :func:`opt_state_specs`, so a snapshot restores
+    directly into the 4D layout (every host reads only its shards) without
+    materializing the global arrays anywhere.  This is what makes the 4D
+    path restartable: checkpoint/resume at scale needs no gather step.
+    Mirrors the reference's full trainer-state resume
+    (chainer/train_mnist.py:120-122) for the megatron engine.
+    """
+    p_shapes = jax.eval_shape(partial(init_params, cfg),
+                              jax.random.PRNGKey(0))
+    o_shapes = jax.eval_shape(optimizer.init, p_shapes)
+
+    def to_sds(leaf, spec):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    return (jax.tree.map(to_sds, p_shapes, param_specs(cfg)),
+            jax.tree.map(to_sds, o_shapes,
+                         opt_state_specs(cfg, optimizer)))
+
+
 def shard_lm_batch(mesh: Mesh, batch: dict) -> dict:
     """Place tokens/targets/mask as [batch@'data', seq@'seq'] global arrays.
 

@@ -56,6 +56,12 @@ def main():
               "layers-per-stage divisible by v)")
     flag(parser, "--mesh", default="",
          help="data,seq,pipe,model sizes, e.g. 1,2,2,2 (default: auto)")
+    flag(parser, "--out", "-o", default="",
+         help="checkpoint directory (empty = no checkpointing)")
+    flag(parser, "--resume", "-r", action="store_true",
+         help="resume from the latest snapshot in --out")
+    flag(parser, "--ckpt-interval", type=int, default=0,
+         help="snapshot every N steps (0 = only at the end)")
     args = parser.parse_args()
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")
@@ -106,25 +112,64 @@ def main():
     opt_state = M.init_optimizer(cfg, mesh, opt, params)
     step = M.make_megatron_train_step(cfg, mesh, opt)
 
+    # checkpoint/resume for the 4D path: snapshots hold the SHARDED
+    # (params, opt_state) — orbax writes/reads per-host shards against the
+    # abstract_state target, no gather — plus the step counter, so an
+    # interrupted run (or the launcher's --max-restarts) continues exactly
+    ckpt = start_step = None
+    if args.out:
+        from dtdl_tpu.ckpt import Checkpointer
+        ckpt = Checkpointer(args.out, keep=3)
+        if args.resume:
+            a_params, a_opt = M.abstract_state(cfg, mesh, opt)
+            like = {"params": a_params, "opt_state": a_opt,
+                    "step": jax.ShapeDtypeStruct((), np.int64)}
+            snap, at = ckpt.restore(like)
+            if snap is not None:
+                params, opt_state = snap["params"], snap["opt_state"]
+                start_step = int(snap["step"])
+                print(f"resumed from snapshot at step {start_step}",
+                      flush=True)
+    start_step = start_step or 0
+    if start_step >= args.steps:
+        # e.g. the launcher's --max-restarts rerunning a job whose
+        # end-of-run snapshot already exists: nothing to train, exit clean
+        print(f"already complete: snapshot at step {start_step} >= "
+              f"--steps {args.steps}; nothing to do", flush=True)
+        ckpt.close()
+        return
+
     reporter = Reporter([StdoutSink()])
     B, S = args.batch_size, args.seq_len
     n_seqs = len(train_tokens)
-    for i in range(args.steps):
-        take = np.arange(i * B, (i + 1) * B) % n_seqs
-        toks = train_tokens[take]
-        batch = M.shard_lm_batch(mesh, {
-            "tokens": toks[:, :-1].astype(np.int32),
-            "targets": toks[:, 1:].astype(np.int32),
-            "mask": np.ones((B, S), np.float32),
-        })
-        params, opt_state, loss, metrics = step(
-            params, opt_state, batch["tokens"], batch["targets"],
-            batch["mask"])
-        if i % args.log_interval == 0:
-            reporter.report({"step": i, "loss": float(loss),
-                             "mesh": str(shape),
-                             **{k: float(v) for k, v in metrics.items()}})
-    print(f"final loss {float(loss):.4f} on mesh {shape}", flush=True)
+    loss = float("nan")
+    try:
+        for i in range(start_step, args.steps):
+            take = np.arange(i * B, (i + 1) * B) % n_seqs
+            toks = train_tokens[take]
+            batch = M.shard_lm_batch(mesh, {
+                "tokens": toks[:, :-1].astype(np.int32),
+                "targets": toks[:, 1:].astype(np.int32),
+                "mask": np.ones((B, S), np.float32),
+            })
+            params, opt_state, loss, metrics = step(
+                params, opt_state, batch["tokens"], batch["targets"],
+                batch["mask"])
+            done = i + 1
+            if i % args.log_interval == 0:
+                reporter.report({"step": i, "loss": float(loss),
+                                 "mesh": str(shape),
+                                 **{k: float(v) for k, v in metrics.items()}})
+            if ckpt and ((args.ckpt_interval and done % args.ckpt_interval
+                          == 0) or done == args.steps):
+                ckpt.save(done, {"params": params, "opt_state": opt_state,
+                                 "step": np.int64(done)})
+    finally:
+        if ckpt:
+            ckpt.wait_until_finished()
+            ckpt.close()
+    print(f"final loss {float(loss):.6f} at step {args.steps} "
+          f"on mesh {shape}", flush=True)
 
 
 if __name__ == "__main__":

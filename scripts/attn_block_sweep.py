@@ -1,0 +1,47 @@
+"""Flash-attention block-size sweep at the 'base' geometry (round 4).
+
+Times fwd+bwd of the Pallas kernel alone for block_q x block_k combos at
+B=8 H=4 D=128 S=4096 bf16 (the bench headline geometry) on the real chip.
+"""
+import itertools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtdl_tpu.ops.attention import flash_attention
+
+B, H, S, D = 8, 8, 4096, 64
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
+k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
+v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
+
+# useful causal matmul flops (fwd 2 mm + bwd counted 2x fwd)
+useful = 3 * 2 * 2 * B * H * S * S * D * 0.5
+
+for bq, bk in [(512, 512), (1024, 1024)]:
+    try:
+        def loss(q, k, v, bq=bq, bk=bk):
+            o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32))
+
+        f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        g = f(q, k, v)
+        float(jnp.sum(g[0].astype(jnp.float32)))
+        n = 20
+        t0 = time.perf_counter()
+        for _ in range(n):
+            g = f(q, k, v)
+        s = float(jnp.sum(g[0].astype(jnp.float32)))
+        dt = (time.perf_counter() - t0) / n
+        print(json.dumps({"bq": bq, "bk": bk, "ms": round(dt * 1e3, 3),
+                          "useful_tflops": round(useful / dt / 1e12, 1),
+                          "pct_peak": round(100 * useful / dt / 197e12, 1)}),
+              flush=True)
+    except Exception as e:
+        print(json.dumps({"bq": bq, "bk": bk,
+                          "error": f"{type(e).__name__}: {e}"[:120]}),
+              flush=True)

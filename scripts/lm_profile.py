@@ -1,0 +1,84 @@
+"""Per-op profile of the LM train step (round-4 roofline analysis).
+
+Captures a jax.profiler trace of the 'base' bs=8 seq=4096 train step on
+the real chip and aggregates XLA op time by category / op name from the
+raw trace events (pid 3 tid 3 = XLA ops on this backend; the
+tensorboard_plugin_profile converter is incompatible with the installed
+TF, so the trace JSON is parsed by hand).
+"""
+import collections
+import glob
+import gzip
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dtdl_tpu.models import transformer_lm
+from dtdl_tpu.parallel import choose_strategy
+from dtdl_tpu.train import init_state, make_lm_train_step
+
+SIZE = sys.argv[1] if len(sys.argv) > 1 else "base"
+BS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+SEQ = int(sys.argv[3]) if len(sys.argv) > 3 else 4096
+CHUNK = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+TRACE_DIR = "/tmp/lm_trace"
+
+strategy = choose_strategy("auto")
+model = transformer_lm(SIZE, max_seq=SEQ)
+state = strategy.replicate(init_state(
+    model, jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32),
+    optax.adamw(3e-4)))
+step = make_lm_train_step(strategy, vocab_chunk_size=CHUNK)
+rng = np.random.default_rng(0)
+batch = strategy.shard_batch({"tokens": jnp.asarray(
+    rng.integers(0, model.vocab_size, (BS, SEQ)), jnp.int32)})
+compiled = step.lower(state, batch).compile()
+for _ in range(5):
+    state, m = compiled(state, batch)
+float(m["loss"])
+
+jax.profiler.start_trace(TRACE_DIR)
+for _ in range(3):
+    state, m = compiled(state, batch)
+float(m["loss"])
+jax.profiler.stop_trace()
+
+path = sorted(glob.glob(TRACE_DIR + "/plugins/profile/*/*.trace.json.gz"))[-1]
+with gzip.open(path, "rt") as f:
+    trace = json.load(f)
+
+events = [e for e in trace["traceEvents"]
+          if e.get("ph") == "X" and e.get("pid") == 3 and e.get("tid") == 3]
+by_name = collections.defaultdict(lambda: [0.0, 0, "", 0.0])
+total = 0.0
+for e in events:
+    dur = e.get("dur", 0) / 1e6  # us -> s
+    total += dur
+    args = e.get("args", {})
+    key = e["name"].split(".")[0]
+    rec = by_name[key]
+    rec[0] += dur
+    rec[1] += 1
+    rec[2] = args.get("hlo_category", rec[2])
+    try:
+        rec[3] += float(args.get("bytes_accessed", 0) or 0)
+    except (TypeError, ValueError):
+        pass
+
+rows = sorted(by_name.items(), key=lambda kv: -kv[1][0])
+print(json.dumps({"config": {"size": SIZE, "bs": BS, "seq": SEQ,
+                             "chunk": CHUNK},
+                  "total_s_3steps": round(total, 6)}))
+for name, (dur, n, cat, bytes_acc) in rows[:30]:
+    print(json.dumps({
+        "op": name[:60], "cat": cat, "calls": n,
+        "time_ms": round(dur * 1e3, 3),
+        "pct": round(100 * dur / total, 2),
+        "gb_accessed": round(bytes_acc / 1e9, 3),
+        "gbps": round(bytes_acc / 1e9 / dur, 1) if dur else 0,
+    }))

@@ -1,0 +1,98 @@
+"""LM step-time sweep for the roofline analysis (round 4).
+
+Times the causal-LM train step across (size, bs, seq, vocab-chunk)
+configs on the real chip, and compares XLA cost_analysis FLOPs against
+an analytic matmul-FLOP count — cost_analysis cannot see inside Pallas
+kernels, so the flash-attention FLOPs are missing from the reported MFU.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dtdl_tpu.models import transformer_lm
+from dtdl_tpu.parallel import choose_strategy
+from dtdl_tpu.train import init_state, make_lm_train_step
+
+
+def analytic_flops(cfg, batch, seq):
+    """Matmul-only model FLOPs for one train step (fwd + 2x bwd).
+
+    Causal attention is counted at the computed half (the kernel skips
+    above-diagonal tiles) — conservative vs quoting dense S^2 work.
+    """
+    t = seq - 1
+    d_model, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
+    d_ff, v, layers = cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    qkvo = 4 * 2 * batch * t * d_model * (h * hd)
+    attn = 2 * 2 * batch * h * t * t * hd * 0.5
+    mlp = 3 * 2 * batch * t * d_model * d_ff
+    head = 2 * batch * t * d_model * v
+    fwd = layers * (qkvo + attn + mlp) + head
+    return 3.0 * fwd
+
+
+def bench(size, bs, seq, chunk, iters=30, warmup=5):
+    strategy = choose_strategy("auto")
+    model = transformer_lm(size, max_seq=seq)
+    tx = optax.adamw(3e-4)
+    state = strategy.replicate(init_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32), tx))
+    step = make_lm_train_step(strategy, vocab_chunk_size=chunk)
+    rng = np.random.default_rng(0)
+    batches = [strategy.shard_batch({
+        "tokens": jnp.asarray(
+            rng.integers(0, model.vocab_size, (bs, seq)), jnp.int32),
+    }) for _ in range(4)]
+    compiled = step.lower(state, batches[0]).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    xla_flops = float(ca.get("flops") or 0)
+
+    for i in range(warmup):
+        state, m = compiled(state, batches[i % 4])
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, m = compiled(state, batches[i % 4])
+    loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss)
+    step_ms = 1e3 * dt / iters
+    af = analytic_flops(model, bs, seq)
+    peak = 197e12
+    row = {
+        "size": size, "bs": bs, "seq": seq, "chunk": chunk,
+        "step_ms": round(step_ms, 3),
+        "tokens_per_sec": round(bs * (seq - 1) * iters / dt, 0),
+        "xla_flops": xla_flops, "analytic_flops": af,
+        "mfu_xla": round(xla_flops * iters / dt / peak, 4),
+        "mfu_analytic": round(af * iters / dt / peak, 4),
+    }
+    return row
+
+
+if __name__ == "__main__":
+    configs = [
+        ("small", 8, 4096, 0),
+        ("small", 32, 4096, 4096),
+        ("base", 8, 4096, 0),
+        ("base", 16, 4096, 4096),
+        ("base", 32, 4096, 4096),
+        ("base", 32, 2048, 4096),
+    ]
+    if len(sys.argv) > 1:
+        idx = [int(x) for x in sys.argv[1].split(",")]
+        configs = [configs[i] for i in idx]
+    for c in configs:
+        try:
+            row = bench(*c)
+        except Exception as e:
+            row = {"size": c[0], "bs": c[1], "seq": c[2], "chunk": c[3],
+                   "error": f"{type(e).__name__}: {e}"[:200]}
+        print(json.dumps(row), flush=True)

@@ -261,3 +261,24 @@ def test_every_example_parses_help():
         capture_output=True, text=True, timeout=300, env=CPU_ENV, cwd=EX)
     assert proc.returncode == 0 and "ALL_HELP_OK" in proc.stdout, (
         f"--help failures:\n{proc.stdout}\n{proc.stderr}")
+
+
+@pytest.mark.slow
+def test_train_lm_4d_checkpoint_resume(tmp_path):
+    """True process-restart resume of the 4D path: a 3-step run that
+    snapshots, then a fresh process resuming to step 6, must land on the
+    same final loss as one uninterrupted 6-step process (sharded orbax
+    restore against the abstract_state target)."""
+    ck = str(tmp_path / "ck")
+    common = ["--batch-size", "8", "--seq-len", "64", "--n-experts", "2",
+              "--mesh", "1,2,2,1", "--log-interval", "2"]
+    full = run_example("train_lm_4d.py", "--steps", "6",
+                       "--out", str(tmp_path / "full"), *common)
+    run_example("train_lm_4d.py", "--steps", "3", "--out", ck, *common)
+    resumed = run_example("train_lm_4d.py", "--steps", "6", "--out", ck,
+                          "--resume", *common)
+    assert "resumed from snapshot at step 3" in resumed
+    m_full = re.search(r"final loss ([\d.]+)", full)
+    m_res = re.search(r"final loss ([\d.]+)", resumed)
+    assert m_full and m_res, (full, resumed)
+    assert m_full.group(1) == m_res.group(1), (full, resumed)
