@@ -72,8 +72,20 @@ class MegatronConfig:
     schedule: str = "1f1b"        # '1f1b' (default) or 'gpipe'
     virtual_stages: int = 1       # v chunks/device: interleaved 1F1B when >1
     moe_dispatch: str = "routed"  # 'routed' (capacity + all-to-all) | 'dense'
-    capacity_factor: float = 1.25  # per-expert slots = cf * tokens / E
+    capacity_factor: float = 1.25  # per-expert slots = cf * tokens*k / E
+    moe_top_k: int = 1            # experts per token (1 = Switch, 2 = GShard)
+    # Switch-style load-balance aux loss weight, ADDED TO THE TRAINING LOSS
+    # (not just a metric): capacity-factor routing with no balance pressure
+    # collapses onto few experts and drops a growing token fraction — the
+    # 0.01 default is the Switch Transformer setting.  0 disables.
+    moe_aux_weight: float = 0.01
     dtype: jnp.dtype = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in [1, n_experts="
+                f"{self.n_experts}]")
 
     @property
     def head_dim(self):
@@ -223,8 +235,26 @@ def _mlp_dense(cfg, p, x):
     return lax.psum(jnp.einsum("bsf,fd->bsd", h, wo), MODEL)
 
 
+def _aux_balance_loss(first_choice_cnt, prob_sum, n_tok_global, n_experts):
+    """Switch-Transformer load-balance loss E * <f, p> from GLOBAL stats.
+
+    ``first_choice_cnt``/``prob_sum``/``n_tok_global`` must already be
+    summed over every axis that partitions tokens, so the value (and its
+    gradient through ``prob_sum``) is identical on every shard — which is
+    what lets the dense-dispatch oracle and the routed path compute the
+    same number, and the unsharded test oracle reproduce it.  ``f`` (the
+    dispatch fractions) comes from argmax counts and is a constant under
+    autodiff; the gradient pushes the *probabilities* toward balance.
+    Matches the flax MoE module's sow'd aux (models/transformer.py).
+    """
+    denom = jnp.maximum(n_tok_global, 1.0)
+    f = first_choice_cnt / denom
+    pbar = prob_sum / denom
+    return n_experts * jnp.sum(f * pbar)
+
+
 def _mlp_moe_routed(cfg, p, x):
-    """Capacity-factor top-1 routed MoE: token all-to-all over 'model'.
+    """Capacity-factor top-k routed MoE: token all-to-all over 'model'.
 
     Real expert parallelism (the dense one-hot path below is the oracle):
     dispatch FLOPs are linear in tokens, not tokens x experts.
@@ -232,23 +262,29 @@ def _mlp_moe_routed(cfg, p, x):
     Inside shard_map, ``x`` is MODEL-invariant (every tp shard holds the
     same tokens), so dispatch starts by *partitioning* the token set over
     'model' — each shard routes its T/tp slice (Megatron sequence-parallel
-    MoE shape).  Per (source shard, expert) capacity ``C = ceil(cf * T_loc
-    / E)`` slots; each shard scatters its kept tokens into an [E, C, D]
-    send buffer (overflow tokens *dropped*, Switch-Transformer semantics),
-    one ``lax.all_to_all`` delivers every expert's tokens to the shard that
-    owns it, the expert FFNs run batched over [e_loc, tp*C, D], and a
-    second all-to-all returns outputs to the token's source shard, where
-    they are gathered back to token order, gated, and psum-restored to the
-    MODEL-invariant layout every block ends with.
+    MoE shape).  Routing takes the top ``cfg.moe_top_k`` experts per token
+    (k=1: Switch, gate = raw top prob; k=2: GShard, gates renormalized over
+    the chosen pair).  Per (source shard, expert) capacity ``C = ceil(cf *
+    T_loc * k / E)`` slots, filled first-choices-first so a second choice
+    never evicts a first choice; overflow assignments are *dropped*
+    (Switch semantics).  One ``lax.all_to_all`` delivers every expert's
+    tokens to the shard that owns it, the expert FFNs run batched over
+    [e_loc, tp*C*k, D], and a second all-to-all returns outputs to the
+    token's source shard, where they are gathered back to token order,
+    gate-combined, and psum-restored to the MODEL-invariant layout every
+    block ends with.
 
-    Returns ``(y, (n_dropped, n_tokens))`` — the dropped-token accounting
-    (already psummed over 'model') that the train step reports as
-    ``moe_dropped_frac``.
+    Returns ``(y, (n_dropped, n_assign, aux))``: dropped/total *assignment*
+    accounting (psummed over 'model'; the step reports their ratio as
+    ``moe_dropped_frac``) and the load-balance aux loss from global router
+    stats (`_aux_balance_loss`), which the train step adds to the loss
+    with weight ``cfg.moe_aux_weight``.
     """
     e_loc = p["wi"].shape[0]                     # local experts (E / tp)
     tp = lax.axis_size(MODEL)
     my = lax.axis_index(MODEL)
     E = e_loc * tp
+    K = cfg.moe_top_k
     b, s, D = x.shape
     T = b * s
     xf = x.reshape(T, D)
@@ -261,19 +297,43 @@ def _mlp_moe_routed(cfg, p, x):
 
     logits = jnp.einsum("td,de->te", xs.astype(jnp.float32), p["router"])
     probs = jax.nn.softmax(logits, -1)
-    gate = jnp.max(probs, -1)                    # top-1 gate value
-    eid = jnp.where(valid, jnp.argmax(probs, -1), E)  # padding routes nowhere
-    C = max(1, math.ceil(cfg.capacity_factor * T_loc / E))
-    oh = jax.nn.one_hot(eid, E, dtype=jnp.int32)  # zero row for eid == E
-    pos = jnp.take_along_axis(jnp.cumsum(oh, 0) - 1,
-                              jnp.clip(eid, 0, E - 1)[:, None], 1)[:, 0]
-    kept = (eid < E) & (pos < C)
-    n_drop = jnp.sum((valid & ~kept).astype(jnp.float32))
-    n_tok = jnp.sum(valid.astype(jnp.float32))
+    topv, topi = lax.top_k(probs, K)             # [T_loc, K]
+    if K == 1:
+        gate_w = topv                            # Switch: raw top-1 prob
+    else:                                        # GShard: renormalized pair
+        gate_w = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+    eid = jnp.where(valid[:, None], topi, E)     # padding routes nowhere
 
-    # scatter my tokens into per-expert slots; out-of-capacity rows drop
-    send = jnp.zeros((E, C, D), cfg.dtype).at[eid, pos].set(
-        xs.astype(cfg.dtype), mode="drop")
+    # load-balance stats over the GLOBAL batch: sum over the 'model' token
+    # partition AND the data/seq shards, so every shard holds the same aux
+    cnt1 = jnp.sum(jax.nn.one_hot(eid[:, 0], E, dtype=jnp.float32), 0)
+    prob_sum = jnp.sum(probs * valid[:, None].astype(jnp.float32), 0)
+    n_tok_g = jnp.sum(valid.astype(jnp.float32))
+    # pcast to one varying set first: n_tok_g is shape-derived (invariant
+    # over data/seq) while cnt1/prob_sum vary — psum rejects mixed states
+    cnt1, prob_sum, n_tok_g = lax.psum(
+        tuple(_vary(a, (DATA, SEQ, MODEL))
+              for a in (cnt1, prob_sum, n_tok_g)),
+        (DATA, SEQ, MODEL))
+    aux = _aux_balance_loss(cnt1, prob_sum, n_tok_g, E)
+
+    # choice-major flattening: ALL first choices take slots before any
+    # second choice, so k=1 behavior is unchanged and a 2nd choice never
+    # displaces a 1st
+    eidf = eid.T.reshape(K * T_loc)
+    validf = jnp.tile(valid, K)
+    C = max(1, math.ceil(cfg.capacity_factor * T_loc * K / E))
+    oh = jax.nn.one_hot(eidf, E, dtype=jnp.int32)  # zero row for eid == E
+    pos = jnp.take_along_axis(jnp.cumsum(oh, 0) - 1,
+                              jnp.clip(eidf, 0, E - 1)[:, None], 1)[:, 0]
+    kept = (eidf < E) & (pos < C)
+    n_drop = jnp.sum((validf & ~kept).astype(jnp.float32))
+    n_assign = jnp.sum(validf.astype(jnp.float32))
+
+    # scatter assignments into per-expert slots; out-of-capacity rows drop
+    xsk = jnp.tile(xs.astype(cfg.dtype), (K, 1))   # choice-major copies
+    send = jnp.zeros((E, C, D), cfg.dtype).at[eidf, pos].set(
+        xsk, mode="drop")
     # a2a #1: expert-major chunks -> the shard owning those experts
     recv = lax.all_to_all(send, MODEL, 0, 0, tiled=True)  # [tp*e_loc, C, D]
     toks = recv.reshape(tp, e_loc, C, D).transpose(1, 0, 2, 3)
@@ -288,15 +348,17 @@ def _mlp_moe_routed(cfg, p, x):
     back = out.reshape(e_loc, tp, C, D).transpose(1, 0, 2, 3)
     back = back.reshape(tp * e_loc, C, D)
     ybuf = lax.all_to_all(back, MODEL, 0, 0, tiled=True)  # [E, C, D]
-    y = ybuf.at[eid, pos].get(mode="fill", fill_value=0)  # [T_loc, D]
-    y = y * (gate * kept.astype(jnp.float32)).astype(cfg.dtype)[:, None]
+    yk = ybuf.at[eidf, pos].get(mode="fill", fill_value=0)  # [K*T_loc, D]
+    w_k = (gate_w.T.reshape(K * T_loc) * kept.astype(jnp.float32))
+    y = jnp.sum((yk * w_k.astype(cfg.dtype)[:, None]).reshape(K, T_loc, D),
+                axis=0)
 
     # restore the full MODEL-invariant token set (each shard contributes
     # its slice; the psum is the same row-parallel combine the dense MLP
     # block ends with)
     yfull = jnp.zeros((tp, T_loc, D), cfg.dtype).at[my].set(y)
     yfull = lax.psum(yfull, MODEL).reshape(Tp, D)[:T]
-    stats = (lax.psum(n_drop, MODEL), lax.psum(n_tok, MODEL))
+    stats = (lax.psum(n_drop, MODEL), lax.psum(n_assign, MODEL), aux)
     return yfull.reshape(b, s, D), stats
 
 
@@ -305,44 +367,69 @@ def _mlp_moe(cfg, p, x):
 
     O(tokens x experts) compute — kept as the *oracle* for the routed path
     (``moe_dispatch='dense'``); with ample capacity the two compute the
-    identical function (tests/test_megatron.py)."""
+    identical function, at any ``moe_top_k`` (tests/test_megatron.py).
+
+    Returns ``(y, (0, 0, aux))``: dense dispatch never drops, and the
+    load-balance aux uses the same global-stats formula as the routed
+    path — here tokens are MODEL-replicated, so the stat psum spans only
+    the data/seq shards."""
     e_loc = p["wi"].shape[0]                     # [E/tp, D, F] local experts
     my = lax.axis_index(MODEL)
+    E = e_loc * lax.axis_size(MODEL)
+    K = cfg.moe_top_k
     router = p["router"]                         # [D, E] replicated
     logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router)
     probs = jax.nn.softmax(logits, -1)
-    idx = jnp.argmax(probs, -1)                  # [b, s] global expert id
-    gate = jnp.max(probs, -1, keepdims=True)     # top-1 gate value
-    local_id = idx - my * e_loc                  # position among my experts
-    onehot = jax.nn.one_hot(local_id, e_loc, dtype=jnp.float32)  # 0 off-shard
+    topv, topi = lax.top_k(probs, K)             # [b, s, K]
+    if K == 1:
+        gate_w = topv
+    else:
+        gate_w = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+
+    cnt1 = jnp.sum(jax.nn.one_hot(topi[..., 0], E, dtype=jnp.float32),
+                   axis=(0, 1))
+    prob_sum = jnp.sum(probs, axis=(0, 1))
+    n_tok = jnp.float32(probs.shape[0] * probs.shape[1])
+    cnt1, prob_sum, n_tok = lax.psum(
+        tuple(_vary(a, (DATA, SEQ)) for a in (cnt1, prob_sum, n_tok)),
+        (DATA, SEQ))
+    aux = _aux_balance_loss(cnt1, prob_sum, n_tok, E)
 
     wi = p["wi"].astype(cfg.dtype)               # [e_loc, D, F]
     wg = p["wg"].astype(cfg.dtype)
     wo = p["wo_mlp"].astype(cfg.dtype)
-    xe = jnp.einsum("bse,bsd->ebsd", onehot.astype(cfg.dtype), x)
-    h = jax.nn.silu(jnp.einsum("ebsd,edf->ebsf", xe, wg)) * \
-        jnp.einsum("ebsd,edf->ebsf", xe, wi)
-    y = jnp.einsum("ebsf,efd->bsd", h, wo)
-    return lax.psum(y, MODEL) * gate.astype(cfg.dtype)
+    y = jnp.zeros(x.shape, cfg.dtype)
+    for k in range(K):
+        local_id = topi[..., k] - my * e_loc     # position among my experts
+        onehot = jax.nn.one_hot(local_id, e_loc, dtype=jnp.float32)
+        xe = jnp.einsum("bse,bsd->ebsd", onehot.astype(cfg.dtype), x)
+        h = jax.nn.silu(jnp.einsum("ebsd,edf->ebsf", xe, wg)) * \
+            jnp.einsum("ebsd,edf->ebsf", xe, wi)
+        yk = jnp.einsum("ebsf,efd->bsd", h, wo)
+        y = y + lax.psum(yk, MODEL) * gate_w[..., k:k + 1].astype(cfg.dtype)
+    zero = jnp.zeros((), jnp.float32)
+    return y, (zero, zero, aux)
 
 
 def _stage_forward(cfg, stage_params, x, cos, sin):
     """Apply this stage's blocks: lax.scan over the stacked layer dim.
 
-    Returns ``(x, (n_dropped, n_tokens))`` — per-stage MoE dropped-token
-    sums (zeros for dense MLP/dense dispatch), stacked by the scan and
-    summed here so the schedules can thread one scalar pair."""
+    Returns ``(x, (n_dropped, n_assign, aux))`` — per-stage MoE
+    dropped-assignment sums (zeros for dense MLP) and the summed
+    load-balance aux over this stage's layers, stacked by the scan and
+    summed here so the schedules can thread one scalar triple."""
     def block(x, p):
         h = _rms(x, p["ln_attn"])
         x = x + _attention(cfg, p, h, cos, sin)
         h = _rms(x, p["ln_mlp"])
         zero = jnp.zeros((), jnp.float32)
-        stats = (zero, zero)
+        stats = (zero, zero, zero)
         if cfg.n_experts and cfg.moe_dispatch == "routed":
             y, stats = _mlp_moe_routed(cfg, p, h)
             x = x + y
         elif cfg.n_experts:
-            x = x + _mlp_moe(cfg, p, h)
+            y, stats = _mlp_moe(cfg, p, h)
+            x = x + y
         else:
             x = x + _mlp_dense(cfg, p, h)
         return x, stats
@@ -373,17 +460,19 @@ def _pipeline(cfg, params, x_micro, cos, sin):
     n_ticks = n_micro + n_stages - 1
 
     def tick(carry, t):
-        buf, outputs, drop, tot = carry
+        buf, outputs, drop, tot, auxs = carry
         # stage 0 injects microbatch t (garbage after n_micro ticks, masked)
         inject = lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
         buf = jnp.where(stage == 0, inject, buf)
         y, st = _stage_forward(cfg, stage_params, buf, cos, sin)
         # this stage holds real (not garbage/masked) data for tick t iff
-        # microbatch t - stage is in range — gate the MoE drop accounting
+        # microbatch t - stage is in range — gate the MoE accounting (the
+        # where also zeroes aux-loss cotangents into garbage ticks)
         active = ((t - stage) >= 0) & ((t - stage) < n_micro)
         drop = drop + jnp.where(active, st[0], 0.0)
         tot = tot + jnp.where(active, st[1], 0.0)
+        auxs = auxs + jnp.where(active, st[2], 0.0)
         # last stage collects output microbatch t - (n_stages - 1)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         collect = (stage == n_stages - 1) & (t >= n_stages - 1)
@@ -394,7 +483,7 @@ def _pipeline(cfg, params, x_micro, cos, sin):
                                    outputs, out_idx, 0, keepdims=False)),
             out_idx, 0)
         buf = lax.ppermute(y, PIPE, perm)
-        return (buf, outputs, drop, tot), None
+        return (buf, outputs, drop, tot, auxs), None
 
     # Carry vma: activations vary over the batch axes and (once stage params
     # touch them) 'pipe'; they stay *invariant* over 'model' because every
@@ -410,13 +499,13 @@ def _pipeline(cfg, params, x_micro, cos, sin):
     outs0 = lax.pcast(jnp.zeros((n_micro,) + mb_shape, cfg.dtype),
                       vary_axes, to="varying")
     stat0 = lax.pcast(jnp.zeros((), jnp.float32), vary_axes, to="varying")
-    (_, outputs, drop, tot), _ = lax.scan(
-        tick, (buf0, outs0, stat0, stat0), jnp.arange(n_ticks))
+    (_, outputs, drop, tot, auxs), _ = lax.scan(
+        tick, (buf0, outs0, stat0, stat0, stat0), jnp.arange(n_ticks))
     # broadcast last stage's outputs to every stage (head/loss replicated)
     outputs = lax.psum(
         jnp.where(stage == n_stages - 1, outputs,
                   jnp.zeros_like(outputs)), PIPE)
-    return outputs, (drop, tot)
+    return outputs, (drop, tot, auxs)
 
 
 def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
@@ -432,7 +521,7 @@ def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
 
     mb = b_loc // n_micro
     x_micro = x.reshape(n_micro, mb, s_loc, cfg.d_model)
-    y, (drop, tot) = _pipeline(cfg, params, x_micro, cos, sin)
+    y, (drop, tot, auxs) = _pipeline(cfg, params, x_micro, cos, sin)
     y = y.reshape(b_loc, s_loc, cfg.d_model)
 
     y = _rms(y, params["ln_f"])
@@ -444,7 +533,18 @@ def _loss_fn(cfg: MegatronConfig, params, tokens, targets, mask):
     local_sum = jnp.sum((lse - true_logit) * mask)
     total = lax.psum(jnp.sum(mask), (DATA, SEQ))
     loss = lax.psum(local_sum, (DATA, SEQ)) / jnp.maximum(total, 1.0)
-    aux = (lax.psum(drop, (DATA, SEQ, PIPE)), lax.psum(tot, (DATA, SEQ, PIPE)))
+    # per-(layer, microbatch) aux values are GLOBAL (psummed over
+    # data/seq/model inside the MoE op), so every data/seq shard
+    # accumulated the same sums: pmean is the value-preserving demotion,
+    # psum would multiply by the shard count.  psum(PIPE) sums the stages'
+    # disjoint layer contributions.
+    aux_mean = jnp.zeros((), jnp.float32)
+    if cfg.n_experts:
+        aux_mean = lax.pmean(lax.psum(auxs, PIPE), (DATA, SEQ)) \
+            / (cfg.n_layers * n_micro)
+        loss = loss + cfg.moe_aux_weight * aux_mean
+    aux = (lax.psum(drop, (DATA, SEQ, PIPE)),
+           lax.psum(tot, (DATA, SEQ, PIPE)), aux_mean)
     return loss, aux
 
 
@@ -597,7 +697,18 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
             lambda a: lax.dynamic_slice_in_dim(a, c * Lc, Lc, 0), p_stage)
 
     def chunk_fn(p, x):
-        return _stage_forward(cfg, p, x, cos, sin)[0]
+        """(activations, load-balance aux) of one chunk — the aux output is
+        part of the differentiated function so the backward lane can inject
+        its loss cotangent (``aux_cot``) through the same rematerialized
+        vjp that produces dx/dw."""
+        y, st = _stage_forward(cfg, p, x, cos, sin)
+        return y, _vary(st[2], (PIPE,))
+
+    # d(loss)/d(chunk aux output): the aux objective is the mean over all
+    # (layer, microbatch) pairs, weighted by moe_aux_weight — each chunk's
+    # aux is a plain sum term, so its cotangent is the constant norm
+    aux_cot_w = (cfg.moe_aux_weight / (cfg.n_layers * M)
+                 if cfg.n_experts else 0.0)
 
     perm_up = [(i, (i + 1) % S) for i in range(S)]
     perm_down = [(i, (i - 1) % S) for i in range(S)]
@@ -621,6 +732,7 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
         loss=_vary(jnp.zeros((), jnp.float32), act_axes),
         drop=_vary(jnp.zeros((), jnp.float32), act_axes),
         tot=_vary(jnp.zeros((), jnp.float32), act_axes),
+        auxs=_vary(jnp.zeros((), jnp.float32), act_axes),
     )
 
     def fwd_indices(t):
@@ -664,6 +776,7 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
         y, st = _stage_forward(cfg, p_f, x_in, cos, sin)
         drop = carry["drop"] + jnp.where(f_active, st[0], 0.0)
         tot = carry["tot"] + jnp.where(f_active, st[1], 0.0)
+        auxs = carry["auxs"] + jnp.where(f_active, st[2], 0.0)
 
         # ---- head on the final chunk's output (last device only) -------
         tgt = lax.dynamic_index_in_dim(tgt_micro, m_idx, 0, keepdims=False)
@@ -685,8 +798,12 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
         dy = jnp.where((stage == S - 1) & (c_b == v - 1),
                        dy_head, carry["buf_b"])
         p_b = chunk_params(c_b)
-        _, chunk_vjp = jax.vjp(chunk_fn, p_b, x_b)
-        dw_m, dx = chunk_vjp(dy)
+        (_, aux_b), chunk_vjp = jax.vjp(chunk_fn, p_b, x_b)
+        # the aux-loss cotangent rides the same rematerialized chunk vjp as
+        # the activation cotangent; inactive backward lanes get zero
+        aux_cot = jnp.where(b_active, jnp.float32(aux_cot_w), 0.0)
+        dw_m, dx = chunk_vjp((dy, _vary(aux_cot,
+                                        jax.typeof(aux_b).vma or ())))
 
         def acc_chunk(a, d):
             cur = lax.dynamic_slice_in_dim(a, c_b * Lc, Lc, 0)
@@ -708,7 +825,7 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
             buf_f=lax.ppermute(y, PIPE, perm_up),
             buf_b=lax.ppermute(dx, PIPE, perm_down),
             x_saved=x_saved, dw=dw, demb=demb,
-            dlnf=dlnf, loss=loss, drop=drop, tot=tot)
+            dlnf=dlnf, loss=loss, drop=drop, tot=tot, auxs=auxs)
         return new_carry, None
 
     carry, _ = lax.scan(tick, carry0, jnp.arange(n_ticks))
@@ -720,8 +837,15 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
                            carry["dw"])
     loss = lax.psum(carry["loss"], (DATA, SEQ, PIPE))
     grads = {"embed": demb, "ln_f": dlnf, "blocks": dblocks}
+    aux_mean = jnp.zeros((), jnp.float32)
+    if cfg.n_experts:
+        # per-(layer, microbatch) aux values are global sums (see
+        # _loss_fn): pmean demotes, psum(PIPE) adds the stages' layers
+        aux_mean = lax.pmean(lax.psum(carry["auxs"], PIPE), (DATA, SEQ)) \
+            / (cfg.n_layers * M)
+        loss = loss + cfg.moe_aux_weight * aux_mean
     aux = (lax.psum(carry["drop"], (DATA, SEQ, PIPE)),
-           lax.psum(carry["tot"], (DATA, SEQ, PIPE)))
+           lax.psum(carry["tot"], (DATA, SEQ, PIPE)), aux_mean)
     return loss, grads, aux
 
 
@@ -775,13 +899,18 @@ def make_megatron_train_step(cfg: MegatronConfig, mesh: Mesh, optimizer):
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         metrics = {}
-        if cfg.n_experts and cfg.moe_dispatch == "routed":
-            drop, tot = aux
-            metrics["moe_dropped_frac"] = drop / jnp.maximum(tot, 1.0)
+        if cfg.n_experts:
+            drop, tot, aux_mean = aux
+            metrics["moe_aux_loss"] = aux_mean
+            if cfg.moe_dispatch == "routed":
+                metrics["moe_dropped_frac"] = drop / jnp.maximum(tot, 1.0)
         return params, opt_state, loss, metrics
 
-    metric_spec = ({"moe_dropped_frac": P()}
-                   if cfg.n_experts and cfg.moe_dispatch == "routed" else {})
+    metric_spec = {}
+    if cfg.n_experts:
+        metric_spec["moe_aux_loss"] = P()
+        if cfg.moe_dispatch == "routed":
+            metric_spec["moe_dropped_frac"] = P()
     batch_spec = P(DATA, SEQ)
     mapped = jax.shard_map(
         step, mesh=mesh,

@@ -46,7 +46,13 @@ def main():
          help="MoE dispatch: capacity-factor top-1 + all-to-all (routed) "
               "or the dense one-hot oracle")
     flag(parser, "--capacity-factor", type=float, default=1.25,
-         help="per-expert token slots = cf * tokens / n_experts (routed)")
+         help="per-expert token slots = cf * tokens * k / n_experts (routed)")
+    flag(parser, "--moe-top-k", type=int, default=1,
+         help="experts per token: 1 = Switch routing, 2 = GShard-style "
+              "renormalized top-2")
+    flag(parser, "--moe-aux-weight", type=float, default=0.01,
+         help="Switch load-balance aux loss weight (added to the training "
+              "loss; 0 disables)")
     flag(parser, "--microbatches", type=int, default=2)
     flag(parser, "--schedule", default="1f1b", choices=["1f1b", "gpipe"],
          help="pipeline schedule")
@@ -91,7 +97,9 @@ def main():
         n_microbatches=args.microbatches, schedule=args.schedule,
         virtual_stages=args.virtual_stages,
         moe_dispatch=args.moe_dispatch,
-        capacity_factor=args.capacity_factor)
+        capacity_factor=args.capacity_factor,
+        moe_top_k=args.moe_top_k,
+        moe_aux_weight=args.moe_aux_weight)
     if args.n_experts and args.n_experts % shape["model"]:
         raise SystemExit(f"--n-experts must be divisible by tp={shape['model']}")
 

@@ -1,10 +1,12 @@
-"""Flash-attention block-size sweep at the 'base' geometry (round 4).
+"""Flash-attention block-size sweep (round-4 roofline artifact).
 
-Times fwd+bwd of the Pallas kernel alone for block_q x block_k combos at
-B=8 H=4 D=128 S=4096 bf16 (the bench headline geometry) on the real chip.
+Times fwd+bwd of the Pallas kernel alone for block_q x block_k combos on
+the real chip.  Default geometry is the bench headline (B=8 H=4 D=128
+S=4096 bf16); pass ``B H S D`` on the command line for others (e.g.
+``8 8 4096 64`` for the head_dim-64 check in LM_ROOFLINE.md section 2).
 """
-import itertools
 import json
+import sys
 import time
 
 import jax
@@ -13,7 +15,10 @@ import numpy as np
 
 from dtdl_tpu.ops.attention import flash_attention
 
-B, H, S, D = 8, 8, 4096, 64
+B, H, S, D = (int(x) for x in (sys.argv[1:5] or (8, 4, 4096, 128)))
+COMBOS = [(bq, bk) for bq in (256, 512, 1024) for bk in (256, 512, 1024)]
+COMBOS += [(1024, 2048), (2048, 1024), (2048, 2048)]
+
 rng = np.random.default_rng(0)
 q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
 k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
@@ -22,7 +27,7 @@ v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
 # useful causal matmul flops (fwd 2 mm + bwd counted 2x fwd)
 useful = 3 * 2 * 2 * B * H * S * S * D * 0.5
 
-for bq, bk in [(512, 512), (1024, 1024)]:
+for bq, bk in COMBOS:
     try:
         def loss(q, k, v, bq=bq, bk=bk):
             o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
@@ -35,7 +40,7 @@ for bq, bk in [(512, 512), (1024, 1024)]:
         t0 = time.perf_counter()
         for _ in range(n):
             g = f(q, k, v)
-        s = float(jnp.sum(g[0].astype(jnp.float32)))
+        float(jnp.sum(g[0].astype(jnp.float32)))
         dt = (time.perf_counter() - t0) / n
         print(json.dumps({"bq": bq, "bk": bk, "ms": round(dt * 1e3, 3),
                           "useful_tflops": round(useful / dt / 1e12, 1),

@@ -14,26 +14,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from bench import lm_analytic_flops, peak_flops_per_chip
 from dtdl_tpu.models import transformer_lm
 from dtdl_tpu.parallel import choose_strategy
 from dtdl_tpu.train import init_state, make_lm_train_step
-
-
-def analytic_flops(cfg, batch, seq):
-    """Matmul-only model FLOPs for one train step (fwd + 2x bwd).
-
-    Causal attention is counted at the computed half (the kernel skips
-    above-diagonal tiles) — conservative vs quoting dense S^2 work.
-    """
-    t = seq - 1
-    d_model, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
-    d_ff, v, layers = cfg.d_ff, cfg.vocab_size, cfg.n_layers
-    qkvo = 4 * 2 * batch * t * d_model * (h * hd)
-    attn = 2 * 2 * batch * h * t * t * hd * 0.5
-    mlp = 3 * 2 * batch * t * d_model * d_ff
-    head = 2 * batch * t * d_model * v
-    fwd = layers * (qkvo + attn + mlp) + head
-    return 3.0 * fwd
 
 
 def bench(size, bs, seq, chunk, iters=30, warmup=5):
@@ -64,8 +48,8 @@ def bench(size, bs, seq, chunk, iters=30, warmup=5):
     dt = time.perf_counter() - t0
     assert np.isfinite(loss)
     step_ms = 1e3 * dt / iters
-    af = analytic_flops(model, bs, seq)
-    peak = 197e12
+    af = lm_analytic_flops(model, bs, seq)
+    peak = peak_flops_per_chip() or float("nan")
     row = {
         "size": size, "bs": bs, "seq": seq, "chunk": chunk,
         "step_ms": round(step_ms, 3),

@@ -47,6 +47,9 @@ def oracle_loss(cfg, params, tokens, targets, mask):
     x = jnp.take(emb, tokens, axis=0)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq)
     b, s, d = x.shape
+    M = cfg.n_microbatches
+    mb = b // M
+    aux_total = jnp.zeros((), jnp.float32)
 
     # layer order of the (interleaved) virtual pipeline: virtual stage
     # u = c*S + st runs device st's chunk-c rows; v=1 is plain stage-major
@@ -76,6 +79,14 @@ def oracle_loss(cfg, params, tokens, targets, mask):
             idx = jnp.argmax(probs, -1)
             gate = jnp.max(probs, -1, keepdims=True)
             onehot = jax.nn.one_hot(idx, cfg.n_experts)
+            # Switch aux per (microbatch, layer): the sharded step computes
+            # f/p over each GLOBAL microbatch (psummed over data/seq/model)
+            pm = probs.reshape(M, mb, s, cfg.n_experts)
+            om = onehot.reshape(M, mb, s, cfg.n_experts)
+            f = jnp.mean(om, axis=(1, 2))            # [M, E]
+            pbar = jnp.mean(pm, axis=(1, 2))         # [M, E]
+            aux_total = aux_total + cfg.n_experts * jnp.sum(
+                jax.lax.stop_gradient(f) * pbar)
             xe = jnp.einsum("bse,bsd->ebsd", onehot, h)
             hh = jax.nn.silu(jnp.einsum("ebsd,edf->ebsf", xe, p["wg"])) \
                 * jnp.einsum("ebsd,edf->ebsf", xe, p["wi"])
@@ -90,7 +101,10 @@ def oracle_loss(cfg, params, tokens, targets, mask):
     logits = jnp.einsum("bsd,vd->bsv", x, emb)
     lse = jax.nn.logsumexp(logits, -1)
     true_logit = jnp.take_along_axis(logits, targets[..., None], -1)[..., 0]
-    return jnp.sum((lse - true_logit) * mask) / jnp.sum(mask)
+    ce = jnp.sum((lse - true_logit) * mask) / jnp.sum(mask)
+    if cfg.n_experts:
+        ce = ce + cfg.moe_aux_weight * aux_total / (cfg.n_layers * M)
+    return ce
 
 
 # ---- tests -----------------------------------------------------------------
@@ -376,3 +390,57 @@ def test_4d_checkpoint_resume_equivalence(devices, tmp_path):
     for a, b in zip(jax.tree.leaves(jax.device_get(params2)),
                     jax.tree.leaves(jax.device_get(params_ref))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_moe_top2_routed_matches_dense(devices):
+    """GShard-style top-2: with capacity that can never drop, the routed
+    all-to-all dispatch and the dense one-hot dispatch compute the same
+    loss and the same parameter update."""
+    mesh = M.build_4d_mesh(devices)
+    batch_host = _batch(cfg := _cfg(n_experts=4, moe_top_k=2,
+                                    moe_dispatch="routed",
+                                    capacity_factor=4.0))
+    results = []
+    for dispatch in ("routed", "dense"):
+        c = _cfg(n_experts=4, moe_top_k=2, moe_dispatch=dispatch,
+                 capacity_factor=4.0)
+        opt = optax.sgd(0.1)
+        params = M.place_params(mesh, c, M.init_params(c, jax.random.PRNGKey(0)))
+        opt_state = M.init_optimizer(c, mesh, opt, params)
+        step = M.make_megatron_train_step(c, mesh, opt)
+        b = M.shard_lm_batch(mesh, batch_host)
+        params, _, loss, metrics = step(
+            params, opt_state, b["tokens"], b["targets"], b["mask"])
+        results.append((float(loss), jax.device_get(params), metrics))
+
+    (loss_r, p_r, m_r), (loss_d, p_d, _) = results
+    assert float(m_r["moe_dropped_frac"]) == 0.0
+    np.testing.assert_allclose(loss_r, loss_d, atol=1e-5, rtol=1e-5)
+    for a, b_ in zip(jax.tree.leaves(p_r), jax.tree.leaves(p_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_moe_aux_loss_flattens_expert_utilization(devices):
+    """The Switch load-balance loss is IN the training loss, not just a
+    metric: training a routed top-1 MoE at tight capacity (cf=1.0) must
+    drive the dropped-assignment fraction down and the aux value toward
+    its balanced optimum of 1.0 (uniform f and p give E * sum(f*p) = 1)."""
+    cfg = _cfg(n_experts=4, capacity_factor=1.0, moe_aux_weight=0.1)
+    mesh = M.build_4d_mesh(devices)
+    opt = optax.adam(3e-2)
+    params = M.place_params(mesh, cfg,
+                            M.init_params(cfg, jax.random.PRNGKey(3)))
+    opt_state = M.init_optimizer(cfg, mesh, opt, params)
+    step = M.make_megatron_train_step(cfg, mesh, opt)
+    b = M.shard_lm_batch(mesh, _batch(cfg))
+    drops, auxes = [], []
+    for _ in range(25):
+        params, opt_state, loss, m = step(
+            params, opt_state, b["tokens"], b["targets"], b["mask"])
+        drops.append(float(m["moe_dropped_frac"]))
+        auxes.append(float(m["moe_aux_loss"]))
+    assert np.mean(drops[-5:]) < 0.7 * np.mean(drops[:5]), (drops[:5],
+                                                            drops[-5:])
+    assert np.mean(auxes[-5:]) < np.mean(auxes[:5]), (auxes[:5], auxes[-5:])
+    assert np.mean(auxes[-5:]) < 1.1   # near the balanced optimum of 1.0
