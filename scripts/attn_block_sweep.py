@@ -15,7 +15,9 @@ import numpy as np
 
 from dtdl_tpu.ops.attention import flash_attention
 
-B, H, S, D = (int(x) for x in (sys.argv[1:5] or (8, 4, 4096, 128)))
+_defaults = (8, 4, 4096, 128)
+_args = [int(x) for x in sys.argv[1:5]]
+B, H, S, D = tuple(_args) + _defaults[len(_args):]
 COMBOS = [(bq, bk) for bq in (256, 512, 1024) for bk in (256, 512, 1024)]
 COMBOS += [(1024, 2048), (2048, 1024), (2048, 2048)]
 

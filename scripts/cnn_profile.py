@@ -1,0 +1,60 @@
+"""Per-op profile of a CNN train step (round-4 PyramidNet bs-sweep).
+
+Usage: python scripts/cnn_profile.py [pyramidnet|resnet50] [batch] [n_top]
+Aggregates XLA op time by hlo category from the raw trace JSON (shared
+parser in scripts/trace_utils.py).
+"""
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dtdl_tpu.models import pyramidnet, resnet50
+from dtdl_tpu.parallel import choose_strategy
+from dtdl_tpu.train import init_state, make_train_step
+from trace_utils import aggregate, xla_events
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "pyramidnet"
+BS = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+NTOP = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+TRACE_DIR = f"/tmp/cnn_trace_{MODEL}_{BS}"
+
+strategy = choose_strategy("auto")
+if MODEL == "resnet50":
+    model, shape, classes = resnet50(dtype=jnp.bfloat16, s2d_stem=True), \
+        (224, 224, 3), 1000
+else:
+    model, shape, classes = pyramidnet(dtype=jnp.bfloat16), (32, 32, 3), 10
+state = strategy.replicate(init_state(
+    model, jax.random.PRNGKey(0), jnp.zeros((1,) + shape),
+    optax.sgd(0.1, momentum=0.9)))
+step = make_train_step(strategy)
+rng = np.random.default_rng(0)
+batch = strategy.shard_batch({
+    "image": jnp.asarray(rng.normal(size=(BS,) + shape), jnp.float32),
+    "label": jnp.asarray(rng.integers(0, classes, BS))})
+compiled = step.lower(state, batch).compile()
+for _ in range(5):
+    state, m = compiled(state, batch)
+float(m["loss"])
+
+jax.profiler.start_trace(TRACE_DIR)
+for _ in range(3):
+    state, m = compiled(state, batch)
+float(m["loss"])
+jax.profiler.stop_trace()
+
+groups, total = aggregate(
+    xla_events(TRACE_DIR), lambda e, args: args.get("hlo_category", "?"))
+print(json.dumps({"model": MODEL, "bs": BS,
+                  "total_ms_per_step": round(total / 3 * 1e3, 3)}))
+for cat, (dur, n, _, b) in list(groups.items())[:NTOP]:
+    print(json.dumps({
+        "cat": cat, "calls_per_step": n // 3,
+        "ms_per_step": round(dur / 3 * 1e3, 3),
+        "pct": round(100 * dur / total, 2),
+        "gb_per_step": round(b / 3e9, 3),
+        "gbps": round(b / 1e9 / dur, 1) if dur else 0}))

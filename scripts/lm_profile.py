@@ -1,17 +1,11 @@
 """Per-op profile of the LM train step (round-4 roofline analysis).
 
 Captures a jax.profiler trace of the 'base' bs=8 seq=4096 train step on
-the real chip and aggregates XLA op time by category / op name from the
-raw trace events (pid 3 tid 3 = XLA ops on this backend; the
-tensorboard_plugin_profile converter is incompatible with the installed
-TF, so the trace JSON is parsed by hand).
+the real chip and aggregates XLA op time by op-name prefix (shared trace
+parser in scripts/trace_utils.py).
 """
-import collections
-import glob
-import gzip
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +15,7 @@ import optax
 from dtdl_tpu.models import transformer_lm
 from dtdl_tpu.parallel import choose_strategy
 from dtdl_tpu.train import init_state, make_lm_train_step
+from trace_utils import aggregate, xla_events
 
 SIZE = sys.argv[1] if len(sys.argv) > 1 else "base"
 BS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -48,33 +43,12 @@ for _ in range(3):
 float(m["loss"])
 jax.profiler.stop_trace()
 
-path = sorted(glob.glob(TRACE_DIR + "/plugins/profile/*/*.trace.json.gz"))[-1]
-with gzip.open(path, "rt") as f:
-    trace = json.load(f)
-
-events = [e for e in trace["traceEvents"]
-          if e.get("ph") == "X" and e.get("pid") == 3 and e.get("tid") == 3]
-by_name = collections.defaultdict(lambda: [0.0, 0, "", 0.0])
-total = 0.0
-for e in events:
-    dur = e.get("dur", 0) / 1e6  # us -> s
-    total += dur
-    args = e.get("args", {})
-    key = e["name"].split(".")[0]
-    rec = by_name[key]
-    rec[0] += dur
-    rec[1] += 1
-    rec[2] = args.get("hlo_category", rec[2])
-    try:
-        rec[3] += float(args.get("bytes_accessed", 0) or 0)
-    except (TypeError, ValueError):
-        pass
-
-rows = sorted(by_name.items(), key=lambda kv: -kv[1][0])
+groups, total = aggregate(
+    xla_events(TRACE_DIR), lambda e, args: e["name"].split(".")[0])
 print(json.dumps({"config": {"size": SIZE, "bs": BS, "seq": SEQ,
                              "chunk": CHUNK},
                   "total_s_3steps": round(total, 6)}))
-for name, (dur, n, cat, bytes_acc) in rows[:30]:
+for name, (dur, n, cat, bytes_acc) in list(groups.items())[:30]:
     print(json.dumps({
         "op": name[:60], "cat": cat, "calls": n,
         "time_ms": round(dur * 1e3, 3),

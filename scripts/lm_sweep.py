@@ -49,15 +49,16 @@ def bench(size, bs, seq, chunk, iters=30, warmup=5):
     assert np.isfinite(loss)
     step_ms = 1e3 * dt / iters
     af = lm_analytic_flops(model, bs, seq)
-    peak = peak_flops_per_chip() or float("nan")
+    peak = peak_flops_per_chip()
     row = {
         "size": size, "bs": bs, "seq": seq, "chunk": chunk,
         "step_ms": round(step_ms, 3),
         "tokens_per_sec": round(bs * (seq - 1) * iters / dt, 0),
         "xla_flops": xla_flops, "analytic_flops": af,
-        "mfu_xla": round(xla_flops * iters / dt / peak, 4),
-        "mfu_analytic": round(af * iters / dt / peak, 4),
     }
+    if peak:   # omit MFU on chips without a known bf16 peak (bench.py's
+        row["mfu_xla"] = round(xla_flops * iters / dt / peak, 4)   # pattern)
+        row["mfu_analytic"] = round(af * iters / dt / peak, 4)
     return row
 
 
