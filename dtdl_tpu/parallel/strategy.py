@@ -212,9 +212,14 @@ class AutoSharded(MeshStrategy):
 
     Params replicated, batch sharded on the data axis; XLA's SPMD partitioner
     inserts the collectives.  The mesh may carry extra axes (model, pipeline,
-    sequence) — pass ``param_spec`` rules to shard parameters for model
-    parallelism; the data-parallel gradient allreduce still falls out of the
-    partitioner automatically.
+    sequence) — pass ``param_spec`` to shard the state for model parallelism;
+    the data-parallel gradient allreduce still falls out of the partitioner
+    automatically.  ``param_spec`` is either one ``PartitionSpec`` applied to
+    every state leaf, or a callable ``(path, leaf) -> PartitionSpec``
+    evaluated over the TrainState tree (``path`` is the jax key path; switch
+    on it / the leaf's shape to shard kernels but replicate biases — the
+    optimizer-state leaves mirror the param shapes, so one shape rule shards
+    both consistently).
     """
 
     def __init__(self, mesh: Mesh | None = None, axis: str = DATA_AXIS,
@@ -222,37 +227,98 @@ class AutoSharded(MeshStrategy):
         super().__init__(mesh, axis)
         self.param_spec = param_spec if param_spec is not None else P()
 
-    def _state_sharding(self):
+    @property
+    def _per_leaf(self):
+        return callable(self.param_spec) and \
+            not isinstance(self.param_spec, P)
+
+    def _state_sharding(self, like=None):
+        if self._per_leaf:
+            if like is None:
+                raise ValueError("per-leaf param_spec needs the state tree")
+            return jax.tree_util.tree_map_with_path(
+                lambda path, leaf: NamedSharding(
+                    self.mesh, self.param_spec(path, leaf)), like)
         return NamedSharding(self.mesh, self.param_spec)
 
     def compile(self, step_fn, donate_state: bool = True):
-        state_s = self._state_sharding()
         batch_s = batch_sharded(self.mesh, self.axis)
+        donate = (0,) if donate_state else ()
+        if self._per_leaf:
+            # The per-leaf sharding tree needs the state's structure, which
+            # compile() doesn't have yet — bind it lazily from the first
+            # state passed in.  in/out shardings are both EXPLICIT: with
+            # out_shardings unspecified the partitioner is free to pick
+            # output placements, and any divergence would compound step to
+            # step (state feeds back in); pinning both sides makes the
+            # placement an invariant instead of a hope.
+            return _LazyPerLeafStep(self, step_fn, batch_s, donate)
+        state_s = self._state_sharding()
         return jax.jit(
             step_fn,
             in_shardings=(state_s, batch_s),
             out_shardings=(state_s, NamedSharding(self.mesh, P())),
-            donate_argnums=(0,) if donate_state else (),
+            donate_argnums=donate,
         )
 
     def compile_eval(self, eval_fn):
+        state_s = None if self._per_leaf else self._state_sharding()
         return jax.jit(
             eval_fn,
-            in_shardings=(self._state_sharding(),
-                          batch_sharded(self.mesh, self.axis)),
+            in_shardings=(state_s, batch_sharded(self.mesh, self.axis)),
             out_shardings=NamedSharding(self.mesh, P()),
         )
 
     def compile_predict(self, predict_fn):
+        state_s = None if self._per_leaf else self._state_sharding()
         return jax.jit(
             predict_fn,
-            in_shardings=(self._state_sharding(),
-                          batch_sharded(self.mesh, self.axis)),
+            in_shardings=(state_s, batch_sharded(self.mesh, self.axis)),
             out_shardings=batch_sharded(self.mesh, self.axis),
         )
 
     def replicate(self, tree):
+        if self._per_leaf:
+            # one device_put with a sharding pytree batches the transfers
+            return jax.device_put(tree, self._state_sharding(like=tree))
         return jax.device_put(tree, self._state_sharding())
+
+
+class _LazyPerLeafStep:
+    """Jitted step whose state shardings bind on first call.
+
+    AutoSharded(param_spec=<callable>) decides shardings per state leaf,
+    but the state tree only exists after ``init_state``/``replicate`` —
+    so the jit (with fully explicit in/out shardings, which is what keeps
+    leaf placements stable across steps) is created on the first
+    invocation and cached.  ``lower`` is forwarded for cost analysis."""
+
+    def __init__(self, strategy: "AutoSharded", step_fn, batch_sharding,
+                 donate):
+        self._strategy = strategy
+        self._step_fn = step_fn
+        self._batch_s = batch_sharding
+        self._donate = donate
+        self._jit = None
+
+    def _bind(self, state):
+        state_s = self._strategy._state_sharding(like=state)
+        mesh = self._strategy.mesh
+        self._jit = jax.jit(
+            self._step_fn,
+            in_shardings=(state_s, self._batch_s),
+            out_shardings=(state_s, NamedSharding(mesh, P())),
+            donate_argnums=self._donate)
+
+    def __call__(self, state, batch):
+        if self._jit is None:
+            self._bind(state)
+        return self._jit(state, batch)
+
+    def lower(self, state, batch):
+        if self._jit is None:
+            self._bind(state)
+        return self._jit.lower(state, batch)
 
 
 def data_parallel_local() -> DataParallel:

@@ -18,6 +18,8 @@ from dtdl_tpu.train import init_state, make_train_step
 from trace_utils import aggregate, xla_events
 
 MODEL = sys.argv[1] if len(sys.argv) > 1 else "pyramidnet"
+if MODEL not in ("pyramidnet", "resnet50"):
+    sys.exit(f"unknown model {MODEL!r}: expected pyramidnet|resnet50")
 BS = int(sys.argv[2]) if len(sys.argv) > 2 else 256
 NTOP = int(sys.argv[3]) if len(sys.argv) > 3 else 20
 TRACE_DIR = f"/tmp/cnn_trace_{MODEL}_{BS}"
