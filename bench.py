@@ -227,6 +227,134 @@ def bench_lm(batch_size: int = 8, seq: int = 4096, size: str = "base",
     return row
 
 
+# ---------------------------------------------------------------------------
+# modeled multi-chip scaling (SCALING.md)
+#
+# This box has ONE tunneled chip; measured multi-chip throughput is not
+# possible.  What IS measurable: the single-chip step time and the exact
+# gradient byte volume every data-parallel replica must allreduce.  The
+# model below turns those into 1->32-chip efficiency curves, with the
+# interconnect constants documented as public-spec estimates.
+# ---------------------------------------------------------------------------
+
+# Effective allreduce bandwidth per chip over ICI (bytes/s).  v5e has a 2D
+# torus with 4 ICI links/chip at ~45 GB/s each per direction; a
+# bandwidth-optimal ring allreduce drives 2 links concurrently -> ~90 GB/s
+# effective.  DCN: ~200 Gbps (25 GB/s) per host NIC, shared by the host's
+# 8 chips; the hierarchical allreduce below accounts for the sharing.
+ICI_ALLREDUCE_BW = 90e9
+DCN_HOST_BW = 25e9
+CHIPS_PER_HOST = 8
+# fraction of the backward pass the grad allreduce can hide under (XLA
+# overlaps collective-start with remaining backward compute, like DDP's
+# bucketed hooks), and backward's share of step time (~2 of 3 passes)
+OVERLAP_FRAC = 0.9
+BWD_FRAC = 2 / 3
+
+
+def _allreduce_time(nbytes: float, n: int, bw: float) -> float:
+    """Ring/bidirectional-exchange allreduce: 2 * B * (N-1)/N / bw."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * nbytes * (n - 1) / n / bw
+
+
+def modeled_scaling(step_time_s: float, grad_bytes: float,
+                    chips=(1, 2, 4, 8, 16, 32)) -> dict:
+    """DDP weak-scaling efficiency: fixed per-chip batch, grads allreduced.
+
+    ``ici``: all chips in one ICI domain (a v5e pod slice).  ``hybrid``:
+    8-chip ICI hosts joined over DCN — intra-host reduce-scatter/allgather
+    leaves each chip 1/8 of the grads, the DCN stage moves that share
+    through 1/8 of the host NIC, then the ICI stage finishes.  Exposed
+    time is whatever the overlap window (OVERLAP_FRAC of the backward)
+    cannot hide.  Efficiency = t_step / (t_step + exposed).
+    """
+    def eff(t_comm, overlap):
+        window = OVERLAP_FRAC * BWD_FRAC * step_time_s if overlap else 0.0
+        exposed = max(0.0, t_comm - window)
+        return round(step_time_s / (step_time_s + exposed), 4)
+
+    out = {"ici": {}, "hybrid": {}, "ici_no_overlap": {},
+           "hybrid_no_overlap": {}, "comm_ms": {}}
+    for n in chips:
+        if n > CHIPS_PER_HOST and n % CHIPS_PER_HOST:
+            raise ValueError(
+                f"chips={n}: counts > {CHIPS_PER_HOST} must be whole hosts "
+                f"(multiples of {CHIPS_PER_HOST}) — a partial host would be "
+                f"silently dropped from the hybrid model")
+        t_ici = _allreduce_time(grad_bytes, n, ICI_ALLREDUCE_BW)
+        hosts = max(1, n // CHIPS_PER_HOST)
+        t_hyb = _allreduce_time(grad_bytes, min(n, CHIPS_PER_HOST),
+                                ICI_ALLREDUCE_BW)
+        if hosts > 1:
+            # per chip: grad_bytes/8 over its 1/8 share of the host NIC
+            t_hyb += _allreduce_time(grad_bytes / CHIPS_PER_HOST, hosts,
+                                     DCN_HOST_BW / CHIPS_PER_HOST)
+        out["ici"][n] = eff(t_ici, overlap=True)
+        out["hybrid"][n] = eff(t_hyb, overlap=True)
+        # worst case: nothing hides (the reference's gloo-era regime)
+        out["ici_no_overlap"][n] = eff(t_ici, overlap=False)
+        out["hybrid_no_overlap"][n] = eff(t_hyb, overlap=False)
+        out["comm_ms"][n] = {"ici": round(t_ici * 1e3, 3),
+                             "hybrid": round(t_hyb * 1e3, 3)}
+    return out
+
+
+def _grad_bytes(model, example) -> float:
+    """f32 gradient bytes of one replica (flax keeps params f32 under
+    bf16 compute; DDP allreduces full-precision grads)."""
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, example), jax.random.PRNGKey(0))
+    return float(sum(np.prod(l.shape) * 4
+                     for l in jax.tree.leaves(shapes)
+                     if hasattr(l, "shape")))
+
+
+def scaling_section(records) -> dict:
+    """Modeled scaling curves for the headline rows of this bench run,
+    plus the reference-sanity point (see SCALING.md)."""
+    from dtdl_tpu.models import pyramidnet, resnet50, transformer_lm
+
+    out = {}
+    for r in records:
+        if "step_time_ms" not in r:
+            continue
+        key = None
+        if r["model"] == "pyramidnet" and r["batch_size"] == 256:
+            key, model, ex = ("pyramidnet_bs256", pyramidnet(),
+                              jnp.zeros((1, 32, 32, 3)))
+        elif r["model"] == "resnet50" and r["batch_size"] == 256:
+            key, model, ex = ("resnet50_bs256", resnet50(),
+                              jnp.zeros((1, 224, 224, 3)))
+        elif r["model"] == "lm" and r.get("size") == "base":
+            key, model, ex = ("lm_base_seq4096",
+                              transformer_lm("base", max_seq=r["seq"]),
+                              jnp.zeros((1, r["seq"]), jnp.int32))
+        if key:
+            gb = _grad_bytes(model, ex)
+            out[key] = {"grad_mbytes": round(gb / 1e6, 1),
+                        **modeled_scaling(r["step_time_ms"] / 1e3, gb)}
+    if out:
+        # sanity anchor: solving the (no-overlap) model for the
+        # reference's published 4-GPU point — PyramidNet, 0.255 s/step,
+        # 75% efficiency (reference pytorch/README.md:122-125) — implies
+        # an effective allreduce bandwidth of ~1.7 GB/s, plausible for
+        # its unoverlapped gloo/PCIe-era allreduce; see SCALING.md
+        if "pyramidnet_bs256" in out:   # same grads; skip the re-trace
+            gb_ref = out["pyramidnet_bs256"]["grad_mbytes"] * 1e6
+        else:
+            gb_ref = _grad_bytes(pyramidnet(), jnp.zeros((1, 32, 32, 3)))
+        t_ref, eff_ref = 0.255, 0.75
+        exposed = t_ref / eff_ref - t_ref
+        out["reference_4gpu_sanity"] = {
+            "measured_eff": eff_ref,
+            "implied_allreduce_gbps": round(
+                2 * gb_ref * 3 / 4 / exposed / 1e9, 2),
+        }
+    return out
+
+
 _SWEEP = {
     # headline (reference parity) model: sweep to find the throughput knee
     "pyramidnet": (64, 256, 1024),
@@ -327,6 +455,12 @@ def main(argv=None) -> dict:
         result["resnet50_samples_per_sec"] = rbest["samples_per_sec"]
         if "mfu" in rbest:
             result["resnet50_mfu"] = rbest["mfu"]
+    try:
+        scaling = scaling_section(ok)
+        if scaling:
+            result["scaling"] = scaling
+    except Exception as e:   # modeled section must never sink the bench
+        print(f"scaling section failed: {e}", file=sys.stderr)
     lm = [r for r in ok if r["model"] == "lm"]
     if lm:
         # throughput and MFU headline may come from different LM sizes

@@ -97,12 +97,42 @@ class MegatronConfig:
 
 
 def factor_mesh(n_devices: int) -> tuple[int, int, int, int]:
-    """(data, seq, pipe, model) sizes — every axis >1 as soon as n allows."""
-    model = 2 if n_devices % 2 == 0 else 1
-    pipe = 2 if n_devices % 4 == 0 else 1
-    seq = 2 if n_devices % 8 == 0 else 1
-    data = n_devices // (model * pipe * seq)
-    return (data, seq, pipe, model)
+    """Cost-aware (data, seq, pipe, model) sizes for ``n_devices``.
+
+    Two regimes:
+
+    * **bootstrap (n <= 8)**: one doubling per axis in model -> pipe -> seq
+      order, so small dev/test meshes exercise every parallelism axis
+      (8 devices -> the canonical {data 1, seq 2, pipe 2, model 2} the
+      test suite runs on).
+    * **growth (n > 8)**: extra factors of two go to the axes in
+      communication-cost order.  Tensor parallel first, up to 8 — its
+      per-layer activation allreduces are the chattiest traffic and must
+      stay inside one ICI domain (8 is the per-host chip count on v5e,
+      the Megatron-LM default).  Pipeline next, up to 4 — per-hop traffic
+      is one activation tensor and latency-tolerant, but the 1F1B bubble
+      grows with stage count so it is capped, not greedy.  Sequence
+      parallel stays at 2 by default (long-context runs that want more
+      pass ``--mesh``).  Data parallelism absorbs everything left,
+      including any odd factor — its one grad allreduce per step overlaps
+      with the backward pass and is the axis that scales over DCN.
+
+    16 -> (1,2,2,4), 32 -> (1,2,2,8), 64 -> (1,2,4,8), 128 -> (2,2,4,8).
+    """
+    shape = {"data": 1, "seq": 1, "pipe": 1, "model": 1}
+    rem = n_devices
+    for ax in ("model", "pipe", "seq"):          # bootstrap doublings
+        if rem % 2 == 0:
+            shape[ax] *= 2
+            rem //= 2
+    while rem % 2 == 0 and shape["model"] < 8:   # tp within ICI first
+        shape["model"] *= 2
+        rem //= 2
+    while rem % 2 == 0 and shape["pipe"] < 4:    # then pp
+        shape["pipe"] *= 2
+        rem //= 2
+    shape["data"] *= rem                         # dp takes the rest
+    return (shape["data"], shape["seq"], shape["pipe"], shape["model"])
 
 
 def build_4d_mesh(devices=None) -> Mesh:

@@ -244,14 +244,24 @@ def test_interleaved_tick_count_and_bubble_drop():
 
 
 def test_factor_mesh():
+    # bootstrap regime: every axis >1 as soon as n allows (test meshes)
     assert M.factor_mesh(1) == (1, 1, 1, 1)
     assert M.factor_mesh(2) == (1, 1, 1, 2)
     assert M.factor_mesh(4) == (1, 1, 2, 2)
     assert M.factor_mesh(8) == (1, 2, 2, 2)
-    assert M.factor_mesh(16) == (2, 2, 2, 2)
-    assert M.factor_mesh(32) == (4, 2, 2, 2)
-    for n in (1, 2, 4, 8, 16, 32):
-        assert int(np.prod(M.factor_mesh(n))) == n
+    # growth regime: tp within ICI first (cap 8), then pp (cap 4), then dp
+    assert M.factor_mesh(16) == (1, 2, 2, 4)
+    assert M.factor_mesh(32) == (1, 2, 2, 8)
+    assert M.factor_mesh(64) == (1, 2, 4, 8)
+    assert M.factor_mesh(128) == (2, 2, 4, 8)
+    assert M.factor_mesh(256) == (4, 2, 4, 8)
+    # odd factors land on the data axis (it has no divisibility coupling)
+    assert M.factor_mesh(6) == (3, 1, 1, 2)
+    assert M.factor_mesh(24) == (3, 2, 2, 2)
+    for n in (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 64, 128, 256):
+        d, s, p, m = M.factor_mesh(n)
+        assert d * s * p * m == n
+        assert m <= 8 and p <= 4
 
 
 def test_moe_capacity_overflow_drops_and_reports(devices):

@@ -1,0 +1,40 @@
+"""Modeled multi-chip scaling curves (bench.py scaling section, SCALING.md)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+
+
+def test_modeled_scaling_shape_and_monotonicity():
+    s = bench.modeled_scaling(0.064, 97.2e6)
+    for curve in ("ici", "hybrid", "ici_no_overlap", "hybrid_no_overlap"):
+        vals = [s[curve][n] for n in (1, 2, 4, 8, 16, 32)]
+        assert all(0.0 < v <= 1.0 for v in vals), (curve, vals)
+        assert all(a >= b - 1e-9 for a, b in zip(vals, vals[1:])), \
+            (curve, vals)   # nonincreasing in chip count
+        assert vals[0] == 1.0
+    # overlap can only help
+    for n in (2, 8, 32):
+        assert s["ici"][n] >= s["ici_no_overlap"][n]
+        assert s["hybrid"][n] >= s["hybrid_no_overlap"][n]
+    # DCN entry at >8 chips makes hybrid strictly costlier than pure ICI
+    assert s["comm_ms"][32]["hybrid"] > s["comm_ms"][32]["ici"]
+
+
+def test_scaling_section_emits_headline_rows_and_sanity():
+    rows = [{"model": "pyramidnet", "batch_size": 256, "step_time_ms": 63.8},
+            {"model": "lm", "size": "base", "seq": 4096, "batch_size": 8,
+             "step_time_ms": 126.7}]
+    out = bench.scaling_section(rows)
+    assert set(out) == {"pyramidnet_bs256", "lm_base_seq4096",
+                        "reference_4gpu_sanity"}
+    assert out["pyramidnet_bs256"]["grad_mbytes"] == 97.2
+    # the model reproduces the reference's 4-GPU point with a physically
+    # plausible effective bandwidth (unoverlapped PCIe-era allreduce)
+    implied = out["reference_4gpu_sanity"]["implied_allreduce_gbps"]
+    assert 0.5 < implied < 5.0, implied
