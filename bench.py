@@ -303,11 +303,13 @@ def modeled_scaling(step_time_s: float, grad_bytes: float,
 
 def _grad_bytes(model, example) -> float:
     """f32 gradient bytes of one replica (flax keeps params f32 under
-    bf16 compute; DDP allreduces full-precision grads)."""
+    bf16 compute; DDP allreduces full-precision grads).  Only the
+    'params' collection counts: BatchNorm running stats are psum-averaged
+    separately, not part of the gradient payload."""
     shapes = jax.eval_shape(
         lambda k: model.init(k, example), jax.random.PRNGKey(0))
     return float(sum(np.prod(l.shape) * 4
-                     for l in jax.tree.leaves(shapes)
+                     for l in jax.tree.leaves(shapes["params"])
                      if hasattr(l, "shape")))
 
 
@@ -378,6 +380,9 @@ def main(argv=None) -> dict:
     p.add_argument("--quick", action="store_true",
                    help="single config only (default pyramidnet bs=64; "
                         "honors explicit --model / --batch-size)")
+    p.add_argument("--sample-budget", type=int, default=0,
+                   help="override the per-config timed sample budget "
+                        "(smoke tests on slow hosts; 0 = default)")
     a = p.parse_args(argv)
 
     if a.quick:
@@ -406,7 +411,8 @@ def main(argv=None) -> dict:
         for size in sizes:
             try:
                 row = (bench_lm(bs, size=size) if model_name == "lm"
-                       else bench_one(model_name, bs))
+                       else bench_one(model_name, bs,
+                                      sample_budget=a.sample_budget or None))
             except Exception as e:  # e.g. OOM at a large batch — record it
                 row = {"model": model_name, "batch_size": bs,
                        "error": f"{type(e).__name__}: {e}"[:200]}

@@ -123,7 +123,8 @@ def make_eval_step(strategy: Strategy | None = None,
 
 
 def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
-                       vocab_chunk_size: int = 0):
+                       vocab_chunk_size: int = 0,
+                       moe_aux_weight: float = 0.01):
     """Compiled causal-LM step ``(state, batch) -> (state, metrics)``.
 
     ``batch``: {'tokens': int32 [B, S]} (optionally 'mask' f32 [B, S-1] over
@@ -137,6 +138,13 @@ def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
     at long sequence.  Requires a model whose ``__call__`` accepts
     ``return_hidden=True`` (TransformerLM does) with a tied ``embed``
     parameter at the top of its param tree.
+
+    MoE models (``n_experts > 0``) sow a Switch load-balance value per MoE
+    layer under the 'aux_loss' collection; the step collects it and ADDS
+    ``moe_aux_weight`` times the layer-mean to the training loss (the
+    megatron path does the same — parallel/megatron.py).  Without this the
+    sow is silently dropped and capacity routing collapses onto few
+    experts.  Reported as the ``moe_aux_loss`` metric; 0 disables.
     """
     strategy = strategy or SingleDevice()
 
@@ -155,12 +163,27 @@ def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
 
         rngs = _dropout_rngs(state, strategy, seed)
 
+        def aux_term(variables):
+            """Weighted layer-mean of the sow'd Switch balance values.
+
+            Per-shard statistic: under DataParallel the loss mean across
+            replicas makes this the mean of per-replica aux — each
+            replica's router sees its own tokens, which is the standard
+            per-device aux formulation."""
+            leaves = jax.tree.leaves(variables.get("aux_loss", {}))
+            if not leaves:      # static at trace time: model has no MoE
+                return None, None
+            aux = sum(leaves) / len(leaves)
+            return moe_aux_weight * aux, aux
+
         if vocab_chunk_size:
             from dtdl_tpu.ops.cross_entropy import chunked_lm_loss
 
             def compute_loss(params):
-                h = state.apply_fn({"params": params}, inputs, train=True,
-                                   rngs=rngs, return_hidden=True)
+                h, muts = state.apply_fn({"params": params}, inputs,
+                                         train=True, rngs=rngs,
+                                         return_hidden=True,
+                                         mutable=["aux_loss"])
                 b, s, d = h.shape
                 emb = params["embed"]
                 if hasattr(emb, "unbox"):   # flax logical-partitioning box
@@ -169,24 +192,35 @@ def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
                     h.reshape(b * s, d), emb,
                     targets.reshape(b * s), mask.reshape(b * s),
                     vocab_chunk_size)
-                return loss_sum * scale, correct * scale
+                loss = loss_sum * scale
+                term, aux = aux_term(muts)
+                if term is not None:
+                    loss = loss + term
+                return loss, (correct * scale, aux)
         else:
             def compute_loss(params):
-                logits = state.apply_fn({"params": params}, inputs,
-                                        train=True, rngs=rngs)
+                logits, muts = state.apply_fn({"params": params}, inputs,
+                                              train=True, rngs=rngs,
+                                              mutable=["aux_loss"])
                 logits = logits.astype(jnp.float32)
                 lse = jax.nn.logsumexp(logits, axis=-1)
                 true = jnp.take_along_axis(
                     logits, targets[..., None].astype(jnp.int32), -1)[..., 0]
                 loss = jnp.sum((lse - true) * mask) * scale
                 correct = (jnp.argmax(logits, -1) == targets)
-                return loss, jnp.sum(correct * mask) * scale
+                term, aux = aux_term(muts)
+                if term is not None:
+                    loss = loss + term
+                return loss, (jnp.sum(correct * mask) * scale, aux)
 
-        (loss, acc), grads = jax.value_and_grad(
+        (loss, (acc, aux)), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(strategy.localize(state.params))
         grads = strategy.grad_sync(grads)
         new_state = state.apply_gradients(grads=grads, batch_stats=None)
-        metrics = strategy.metric_sync({"loss": loss, "accuracy": acc})
+        metrics = {"loss": loss, "accuracy": acc}
+        if aux is not None:
+            metrics["moe_aux_loss"] = aux
+        metrics = strategy.metric_sync(metrics)
         return new_state, metrics
 
     return strategy.compile(step)

@@ -46,6 +46,11 @@ def main():
          help=">0: vocab-chunked LM loss with tiles of N vocab COLUMNS "
               "(e.g. 2048) — the [B,S,V] logits are never materialized, "
               "so large-vocab models fit at long sequence")
+    flag(parser, "--n-experts", type=int, default=0,
+         help=">0: switch-MoE MLPs with this many experts")
+    flag(parser, "--moe-aux-weight", type=float, default=0.01,
+         help="Switch load-balance aux loss weight (added to the "
+              "training loss; 0 disables)")
     args = parser.parse_args()
 
     if args.dataset != "synthetic_lm":
@@ -58,7 +63,7 @@ def main():
 
     train_tokens, _ = load_dataset(args.dataset, seq_len=args.seq_len)
     model = transformer_lm(args.model_size, max_seq=args.seq_len,
-                           attn_impl=args.attn)
+                           attn_impl=args.attn, n_experts=args.n_experts)
     if train_tokens.max() >= model.vocab_size:
         raise SystemExit("dataset vocab exceeds model vocab")
 
@@ -74,7 +79,8 @@ def main():
                        optax.adamw(args.lr))
     state = strategy.replicate(state)
     step = make_lm_train_step(strategy,
-                              vocab_chunk_size=args.vocab_chunk_size)
+                              vocab_chunk_size=args.vocab_chunk_size,
+                              moe_aux_weight=args.moe_aux_weight)
 
     reporter = Reporter([StdoutSink()])
     global_step = 0
@@ -85,11 +91,13 @@ def main():
                 {"tokens": jnp.asarray(batch["tokens"])})
             state, metrics = step(state, sharded)
             if global_step % args.log_interval == 0:
-                reporter.report(
-                    {"epoch": epoch, "step": global_step,
-                     "loss": float(metrics["loss"]),
-                     "accuracy": float(metrics["accuracy"]),
-                     "ppl": float(np.exp(min(20.0, float(metrics["loss"]))))})
+                row = {"epoch": epoch, "step": global_step,
+                       "loss": float(metrics["loss"]),
+                       "accuracy": float(metrics["accuracy"]),
+                       "ppl": float(np.exp(min(20.0, float(metrics["loss"]))))}
+                if "moe_aux_loss" in metrics:
+                    row["moe_aux_loss"] = float(metrics["moe_aux_loss"])
+                reporter.report(row)
             global_step += 1
     if args.save_model:
         path = save_weights(f"{args.out}/lm_final.msgpack", state.params)

@@ -1,11 +1,13 @@
 """Modeled multi-chip scaling curves (bench.py scaling section, SCALING.md)."""
 
-import sys
+import json
 import os
+import subprocess
+import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
+import pytest
 
 import bench
 
@@ -26,6 +28,11 @@ def test_modeled_scaling_shape_and_monotonicity():
     assert s["comm_ms"][32]["hybrid"] > s["comm_ms"][32]["ici"]
 
 
+def test_modeled_scaling_rejects_partial_hosts():
+    with pytest.raises(ValueError, match="whole hosts"):
+        bench.modeled_scaling(0.064, 97.2e6, chips=(12,))
+
+
 def test_scaling_section_emits_headline_rows_and_sanity():
     rows = [{"model": "pyramidnet", "batch_size": 256, "step_time_ms": 63.8},
             {"model": "lm", "size": "base", "seq": 4096, "batch_size": 8,
@@ -33,8 +40,32 @@ def test_scaling_section_emits_headline_rows_and_sanity():
     out = bench.scaling_section(rows)
     assert set(out) == {"pyramidnet_bs256", "lm_base_seq4096",
                         "reference_4gpu_sanity"}
-    assert out["pyramidnet_bs256"]["grad_mbytes"] == 97.2
+    assert out["pyramidnet_bs256"]["grad_mbytes"] == 97.0   # params only, no BN stats
     # the model reproduces the reference's 4-GPU point with a physically
     # plausible effective bandwidth (unoverlapped PCIe-era allreduce)
     implied = out["reference_4gpu_sanity"]["implied_allreduce_gbps"]
     assert 0.5 < implied < 5.0, implied
+
+
+@pytest.mark.slow
+def test_bench_quick_driver_contract():
+    """bench.py --quick must emit EXACTLY ONE JSON line on stdout with the
+    driver's required fields (metric/value/unit/vs_baseline) — the round
+    harness parses stdout's last line; everything human goes to stderr."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(env["PYTHONPATH"], "bench.py"),
+         "--quick", "--model", "pyramidnet", "--batch-size", "8",
+         "--sample-budget", "8"],   # 20 timed iters; CPU hosts are slow
+        capture_output=True, text=True, timeout=1800, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got {lines}"
+    d = json.loads(lines[0])
+    for field in ("metric", "value", "unit", "vs_baseline", "records"):
+        assert field in d, (field, d.keys())
+    assert d["unit"] == "samples/sec" and d["value"] > 0
+    assert len(d["records"]) == 1   # --quick: one config only

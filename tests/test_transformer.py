@@ -251,3 +251,47 @@ def test_chunked_lm_loss_correct_sum_mask_grad():
     logits = h @ emb.T
     want = (jnp.argmax(logits, -1) == tgt).astype(jnp.float32)
     np.testing.assert_array_equal(np.asarray(g), np.asarray(want))
+
+
+def test_lm_step_trains_with_moe_aux_loss():
+    """The flax MoE path's sow'd Switch balance loss is consumed by
+    make_lm_train_step and ADDED to the training loss (same contract as
+    the megatron path) — without the mutable=['aux_loss'] collection the
+    sow is silently dropped and routing trains with no balance pressure."""
+    import optax
+    from dtdl_tpu.parallel import DataParallel, SingleDevice
+    from dtdl_tpu.train import init_state, make_lm_train_step
+
+    model = transformer_lm("tiny", n_experts=4, moe_every=1,
+                           dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 256, (8, 65)), jnp.int32)
+
+    def run(strategy, w):
+        state = strategy.replicate(init_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((1, 65), jnp.int32),
+            optax.sgd(0.1)))
+        step = make_lm_train_step(strategy, moe_aux_weight=w)
+        state, m = step(state, strategy.shard_batch({"tokens": toks}))
+        return {k: float(v) for k, v in m.items()}
+
+    on = run(SingleDevice(), 0.01)
+    off = run(SingleDevice(), 0.0)
+    assert on["moe_aux_loss"] > 0
+    # the aux term is IN the loss, at exactly its weight
+    np.testing.assert_allclose(on["loss"],
+                               off["loss"] + 0.01 * on["moe_aux_loss"],
+                               rtol=1e-6)
+
+    # DDP: per-replica aux (each router balances its own tokens) — the CE
+    # component must still match single-device exactly
+    ddp = run(DataParallel(), 0.01)
+    np.testing.assert_allclose(ddp["loss"] - 0.01 * ddp["moe_aux_loss"],
+                               off["loss"], rtol=1e-5)
+
+    # a dense (no-experts) model emits no aux metric and no aux term
+    plain = transformer_lm("tiny", dtype=jnp.float32)
+    state = init_state(plain, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 65), jnp.int32), optax.sgd(0.1))
+    _, m = make_lm_train_step()(state, {"tokens": toks})
+    assert "moe_aux_loss" not in m
