@@ -410,9 +410,14 @@ def main(argv=None) -> dict:
         sizes = lm_sizes if model_name == "lm" else (None,)
         for size in sizes:
             try:
-                row = (bench_lm(bs, size=size) if model_name == "lm"
-                       else bench_one(model_name, bs,
-                                      sample_budget=a.sample_budget or None))
+                if model_name == "lm":
+                    # budget caps the timed LM iterations too (floor 3)
+                    lm_iters = (max(3, a.sample_budget // bs)
+                                if a.sample_budget else 30)
+                    row = bench_lm(bs, size=size, iters=lm_iters)
+                else:
+                    row = bench_one(model_name, bs,
+                                    sample_budget=a.sample_budget or None)
             except Exception as e:  # e.g. OOM at a large batch — record it
                 row = {"model": model_name, "batch_size": bs,
                        "error": f"{type(e).__name__}: {e}"[:200]}

@@ -23,6 +23,7 @@ projections), 'mlp' (FFN hidden), 'expert' (MoE).
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import flax.linen as nn
@@ -60,7 +61,7 @@ class Attention(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, decode: bool = False):
         d_model = x.shape[-1]
         def proj(name):
             return nn.DenseGeneral(
@@ -74,18 +75,68 @@ class Attention(nn.Module):
         v = proj("v")(x)
         # [B, S, H, D] -> [B, H, S, D]
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        if self.attn_impl == "flash":
-            o = flash_attention(q, k, v, causal=True)
+        if decode:
+            o = self._decode_attend(q, k, v, cos, sin)
         else:
-            o = mha_reference(q, k, v, causal=True).astype(self.dtype)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            if self.attn_impl == "flash":
+                o = flash_attention(q, k, v, causal=True)
+            else:
+                o = mha_reference(q, k, v, causal=True).astype(self.dtype)
         o = o.transpose(0, 2, 1, 3)
         return nn.DenseGeneral(
             features=d_model, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             kernel_init=_part(nn.initializers.lecun_normal(),
                               "heads", "head_dim", "embed"),
             name="out")(o)
+
+    def _decode_attend(self, q, k, v, cos, sin):
+        """Incremental attention against a KV cache ('cache' collection).
+
+        Serves both prefill (S = prompt length) and stepping (S = 1): the
+        new keys/values land at positions [index, index+S) of a
+        [B, H, max_seq, D] cache (max_seq = the rope table length), the
+        rope rotation uses the true global positions, and each new query
+        row attends every cached position up to and including its own.
+        Dense masked attention — decode is one query row against a cache,
+        which is exactly the memory-light shape the flash kernel's tiling
+        is NOT for.  Mutate via ``apply(..., mutable=['cache'])``.
+        """
+        import math
+        b, h, s_new, d = q.shape
+        max_len = cos.shape[0]
+        # has_variable BEFORE self.variable: during the init trace the
+        # cache does not exist yet, and mutating it there would bake the
+        # example input into the returned cache and leave index=1 — every
+        # later position would be off by one
+        is_init = self.has_variable("cache", "key")
+        ck = self.variable("cache", "key", jnp.zeros,
+                           (b, h, max_len, d), self.dtype)
+        cv = self.variable("cache", "value", jnp.zeros,
+                           (b, h, max_len, d), self.dtype)
+        ci = self.variable("cache", "index",
+                           lambda: jnp.zeros((), jnp.int32))
+        if not is_init:
+            return jnp.zeros_like(q)   # shapes only; init collects vars
+        pos = ci.value
+        q = apply_rope(q, cos, sin, offset=pos)
+        k = apply_rope(k, cos, sin, offset=pos)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(self.dtype), (0, 0, pos, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(self.dtype), (0, 0, pos, 0))
+        ci.value = pos + s_new
+
+        qpos = pos + jnp.arange(s_new)                      # [S]
+        mask = jnp.arange(max_len)[None, :] <= qpos[:, None]  # [S, max_len]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
+                            preferred_element_type=jnp.float32)
+        logits = logits / math.sqrt(d)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(self.dtype),
+                          cv.value)
 
 
 class SwiGLU(nn.Module):
@@ -168,10 +219,11 @@ class Block(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, decode: bool = False):
         h = RMSNorm(dtype=self.dtype, name="ln_attn")(x)
         x = x + Attention(self.n_heads, self.head_dim, self.attn_impl,
-                          self.dtype, name="attn")(h, cos, sin)
+                          self.dtype, name="attn")(h, cos, sin,
+                                                   decode=decode)
         h = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
         if self.n_experts > 0:
             x = x + MoE(self.n_experts, self.d_ff, self.dtype,
@@ -201,11 +253,17 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, decode: bool = False):
         """``return_hidden=True`` yields the final normalized hidden states
         [B, S, D] instead of logits — the contract of the vocab-chunked LM
         loss (dtdl_tpu/ops/cross_entropy.py:chunked_lm_loss), which never
-        materializes the [B, S, V] logits."""
+        materializes the [B, S, V] logits.
+
+        ``decode=True`` runs incremental attention against per-block KV
+        caches (the 'cache' variable collection; create it by tracing
+        ``init``/``apply`` with decode=True, mutate with
+        ``mutable=['cache']``) — the autoregressive-generation contract of
+        :func:`generate`."""
         del train
         emb = self.param(
             "embed", _part(nn.initializers.normal(stddev=0.02),
@@ -214,23 +272,110 @@ class TransformerLM(nn.Module):
         x = jnp.take(emb, tokens, axis=0).astype(self.dtype)
         cos, sin = rope_frequencies(self.head_dim, self.max_seq)
 
+        # remat is a training-time memory/FLOPs trade; under decode it
+        # would also trace the `decode` flag into a tracer (remat treats
+        # every call arg as dynamic) — plain blocks for decode
         block_cls = Block
-        if self.remat:
+        if self.remat and not decode:
             block_cls = nn.remat(Block, static_argnums=())
         for i in range(self.n_layers):
             moe = (self.n_experts > 0 and
                    (i + 1) % self.moe_every == 0)
-            x = block_cls(
+            block = block_cls(
                 self.n_heads, self.head_dim, self.d_ff,
                 n_experts=self.n_experts if moe else 0,
                 attn_impl=self.attn_impl, dtype=self.dtype,
-                name=f"block_{i}")(x, cos, sin)
+                name=f"block_{i}")
+            # only pass the flag when set: a kwarg through nn.remat is
+            # traced, and Attention branches on it in Python
+            x = block(x, cos, sin, decode=True) if decode \
+                else block(x, cos, sin)
 
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
         if return_hidden:
             return x
         logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(self.dtype))
         return logits.astype(jnp.float32)
+
+
+def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
+             temperature: float = 0.0, rng=None):
+    """Autoregressive generation with per-block KV caches.
+
+    ``prompt``: int32 [B, S0] (S0 + max_new_tokens must fit
+    ``model.max_seq``; ``max_new_tokens >= 1``).  One prefill pass embeds
+    the whole prompt into the caches, then a ``lax.scan`` of single-token
+    steps decodes — the scan keeps the loop inside ONE compiled program
+    (no per-token dispatch, static shapes throughout; the cache is a
+    fixed [B, H, max_seq, D] buffer indexed by the traced cache
+    position), and the compiled program is cached per
+    (model, shapes, temperature) so repeated calls don't re-trace.
+    ``temperature=0`` is greedy argmax; otherwise samples from
+    logits/temperature with ``rng``.
+
+    Returns int32 [B, S0 + max_new_tokens].  (The reference has no
+    sequence models, let alone inference — SURVEY §5.7; this is part of
+    the framework's first-class LM capability.)
+    """
+    b, s0 = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
+    if s0 + max_new_tokens > model.max_seq:
+        raise ValueError(
+            f"prompt ({s0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq ({model.max_seq})")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    run = _compiled_generate(model, b, s0, max_new_tokens, temperature)
+    return run(params, prompt, rng)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_generate(model, b, s0, max_new_tokens, temperature):
+    """Memoized jitted prefill+scan program for one
+    (model, shape, temperature) signature — repeated generate() calls
+    with the same signature reuse one compiled program.  (flax Modules
+    are frozen dataclasses, so ``model`` is a valid cache key.)"""
+    from jax import lax
+
+    # abstract trace only: the cache is zeros of the right shapes, no
+    # extra full init of the model inside the compiled program
+    cache_shapes = jax.eval_shape(
+        functools.partial(model.init, decode=True),
+        jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32))["cache"]
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    @jax.jit
+    def run(params, prompt, rng):
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             cache_shapes)
+        logits, muts = model.apply(
+            {"params": params, "cache": cache}, prompt, decode=True,
+            mutable=["cache"])
+        rng_0, rng_scan = jax.random.split(rng)
+        tok = sample(logits[:, -1], rng_0)
+
+        def step(carry, key):
+            cache, tok = carry
+            logits, muts = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                decode=True, mutable=["cache"])
+            nxt = sample(logits[:, -1], key)
+            return (muts["cache"], nxt), tok
+
+        keys = jax.random.split(rng_scan, max_new_tokens)[:-1]
+        (_, last), toks = lax.scan(step, (muts["cache"], tok), keys)
+        toks = jnp.moveaxis(toks, 0, 1)           # [B, max_new-1]
+        return jnp.concatenate([prompt, toks, last[:, None]], axis=1)
+
+    return run
 
 
 def transformer_lm(size: str = "tiny", **overrides) -> TransformerLM:
