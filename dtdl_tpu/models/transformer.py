@@ -110,15 +110,16 @@ class Attention(nn.Module):
         # cache does not exist yet, and mutating it there would bake the
         # example input into the returned cache and leave index=1 — every
         # later position would be off by one
-        is_init = self.has_variable("cache", "key")
+        cache_exists = self.has_variable("cache", "key")
         ck = self.variable("cache", "key", jnp.zeros,
                            (b, h, max_len, d), self.dtype)
         cv = self.variable("cache", "value", jnp.zeros,
                            (b, h, max_len, d), self.dtype)
         ci = self.variable("cache", "index",
                            lambda: jnp.zeros((), jnp.int32))
-        if not is_init:
-            return jnp.zeros_like(q)   # shapes only; init collects vars
+        if not cache_exists:
+            # this IS the init trace: shapes only, no cache mutation
+            return jnp.zeros_like(q)
         pos = ci.value
         q = apply_rope(q, cos, sin, offset=pos)
         k = apply_rope(k, cos, sin, offset=pos)
@@ -356,11 +357,20 @@ def _compiled_generate(model, b, s0, max_new_tokens, temperature):
     def run(params, prompt, rng):
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                              cache_shapes)
-        logits, muts = model.apply(
+        # prefill via return_hidden: only the LAST position's logits are
+        # sampled, so the [B, S0, vocab] logit tensor never materializes
+        # (the same never-materialize discipline as chunked_lm_loss)
+        hidden, muts = model.apply(
             {"params": params, "cache": cache}, prompt, decode=True,
-            mutable=["cache"])
+            return_hidden=True, mutable=["cache"])
+        emb = params["embed"]
+        if hasattr(emb, "unbox"):       # flax logical-partitioning box
+            emb = emb.unbox()
+        logits_last = jnp.einsum(
+            "bd,vd->bv", hidden[:, -1].astype(jnp.float32),
+            emb.astype(jnp.float32))
         rng_0, rng_scan = jax.random.split(rng)
-        tok = sample(logits[:, -1], rng_0)
+        tok = sample(logits_last, rng_0)
 
         def step(carry, key):
             cache, tok = carry

@@ -51,6 +51,10 @@ def main():
     flag(parser, "--moe-aux-weight", type=float, default=0.01,
          help="Switch load-balance aux loss weight (added to the "
               "training loss; 0 disables)")
+    flag(parser, "--generate-tokens", type=int, default=0,
+         help=">0: after training, greedily decode this many tokens from "
+              "a training-prefix prompt (KV-cache generate) and print "
+              "them — an end-to-end check of the inference path")
     args = parser.parse_args()
 
     if args.dataset != "synthetic_lm":
@@ -102,6 +106,15 @@ def main():
     if args.save_model:
         path = save_weights(f"{args.out}/lm_final.msgpack", state.params)
         print(f"saved weights to {path}", flush=True)
+    # diagnostic decode runs AFTER the save: a generation error (bad
+    # flag combination, OOM) must never discard the trained weights
+    if args.generate_tokens:
+        from dtdl_tpu.models import generate
+        prompt = jnp.asarray(train_tokens[:1, :8], jnp.int32)
+        params = jax.device_get(state.params)   # host view of (replicated)
+        out = generate(model, params, prompt,
+                       max_new_tokens=args.generate_tokens)
+        print("generated:", np.asarray(out)[0].tolist(), flush=True)
 
 
 if __name__ == "__main__":
