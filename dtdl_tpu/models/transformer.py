@@ -366,9 +366,12 @@ def _compiled_generate(model, b, s0, max_new_tokens, temperature):
         emb = params["embed"]
         if hasattr(emb, "unbox"):       # flax logical-partitioning box
             emb = emb.unbox()
+        # EXACTLY the module head's numerics (dtype-matched einsum, f32
+        # cast after): a higher-precision prefill einsum could pick a
+        # different argmax on near-tied logits than the step path does
         logits_last = jnp.einsum(
-            "bd,vd->bv", hidden[:, -1].astype(jnp.float32),
-            emb.astype(jnp.float32))
+            "bd,vd->bv", hidden[:, -1],
+            emb.astype(model.dtype)).astype(jnp.float32)
         rng_0, rng_scan = jax.random.split(rng)
         tok = sample(logits_last, rng_0)
 
