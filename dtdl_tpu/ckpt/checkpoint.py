@@ -77,10 +77,16 @@ def _validate_shapes(restored, like, origin: str) -> None:
     model would load and then compute a different function or crash far
     from the cause.  Dtype counts too: a same-shape f32 checkpoint loading
     into a bf16 run would silently train in the wrong precision."""
+    leaves_r = jax.tree_util.tree_leaves_with_path(restored)
+    leaves_l = jax.tree_util.tree_leaves_with_path(like)
+    if len(leaves_r) != len(leaves_l):
+        # zip() would silently drop the trailing leaves of the longer tree,
+        # leaving them unvalidated — structure mismatch is its own error
+        raise ValueError(
+            f"checkpoint {origin} tree structure does not match the model: "
+            f"{len(leaves_r)} restored leaves vs {len(leaves_l)} expected")
     bad = []
-    for (path_r, leaf_r), (_, leaf_l) in zip(
-            jax.tree_util.tree_leaves_with_path(restored),
-            jax.tree_util.tree_leaves_with_path(like)):
+    for (path_r, leaf_r), (_, leaf_l) in zip(leaves_r, leaves_l):
         want = getattr(leaf_l, "shape", None)
         got = getattr(leaf_r, "shape", None)
         if want is not None and got is not None and want != got:
@@ -160,6 +166,11 @@ class Checkpointer:
         path = os.path.join(self.directory,
                             f"weights_epoch_{epoch:04d}.msgpack")
         save_weights(path, params)
+        # same rollback semantics as full snapshots: an epoch saved below
+        # existing ones supersedes the abandoned timeline's later epochs,
+        # so latest_weights() never restores a stale future
+        self._supersede(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack",
+                        epoch)
         self._gc(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack",
                  protect=epoch)
         return path
@@ -190,6 +201,15 @@ class Checkpointer:
             os.path.join(self.directory, f"snapshot_{step}"))
         self._checkpointer.save(path, state, force=True)
         self._last_saved_step = step
+        # Saving a step BELOW existing snapshot ids means training rolled
+        # back (restored an older snapshot) and the higher-step snapshots
+        # belong to the abandoned timeline.  They must not survive: they
+        # would win restore(step=None)/latest_step() after a crash, silently
+        # resuming from the pre-rollback timeline, and they'd permanently
+        # occupy `keep` slots so each new-timeline save left only the
+        # just-saved snapshot alive.  restore() waits for in-flight writes
+        # first, so every stale future is durable and visible here.
+        self._supersede(self._SNAP_RE, "snapshot_{}", step)
         # The async save is only *staged* here: the snapshot dir still has
         # its orbax tmp name and _list can't see it.  Trimming over the
         # DURABLE list only (never counting the in-flight step as present)
@@ -256,16 +276,31 @@ class Checkpointer:
         old step must not delete that step's own snapshot."""
         if self.keep is None or not is_leader():
             return
-        import shutil
         ids = self._list(regex)
         for old in ids[:-self.keep]:
             if old == protect:
                 continue
-            victim = os.path.join(self.directory, fmt.format(old))
-            if os.path.isdir(victim):
-                shutil.rmtree(victim)
-            elif os.path.exists(victim):
-                os.remove(victim)
-            meta = victim + ".meta.json"   # Trainer's snapshot sidecar
-            if os.path.exists(meta):
-                os.remove(meta)
+            self._delete(fmt, old)
+
+    def _supersede(self, regex, fmt, just_saved: int) -> None:
+        """Delete every durable entry with an id ABOVE ``just_saved`` — they
+        are stale futures from a timeline abandoned by a rollback restore.
+        Runs regardless of ``keep`` (this is a correctness rule for
+        restore-latest, not retention policy), leader-gated like all
+        deletions."""
+        if not is_leader():
+            return
+        for old in self._list(regex):
+            if old > just_saved:
+                self._delete(fmt, old)
+
+    def _delete(self, fmt, entry_id: int) -> None:
+        import shutil
+        victim = os.path.join(self.directory, fmt.format(entry_id))
+        if os.path.isdir(victim):
+            shutil.rmtree(victim)
+        elif os.path.exists(victim):
+            os.remove(victim)
+        meta = victim + ".meta.json"   # Trainer's snapshot sidecar
+        if os.path.exists(meta):
+            os.remove(meta)

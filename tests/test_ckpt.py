@@ -229,6 +229,60 @@ def test_rollback_resave_of_old_step_survives_gc(tmp_path):
     ck.close()
 
 
+def test_rollback_supersedes_stale_future_snapshots(tmp_path):
+    """After a rollback, snapshots from the abandoned timeline (ids above
+    the re-saved step) must not survive: a crash right after the rollback
+    save would otherwise restore(step=None) from the stale pre-rollback
+    future, and the stale ids would permanently occupy `keep` slots."""
+    import os
+
+    ck = Checkpointer(str(tmp_path), keep=3)
+    state = mk_state()
+    for s in (100, 150, 200):
+        ck.save(s, state, wait=True)
+    # restore an old step, then continue the run from there
+    restored, step = ck.restore(mk_state(seed=3), step=100)
+    assert step == 100
+    ck.save(110, state, wait=True)
+    # the stale futures are gone; latest now points at the new timeline
+    assert ck.latest_step() == 110
+    assert not os.path.isdir(str(tmp_path / "snapshot_150"))
+    assert not os.path.isdir(str(tmp_path / "snapshot_200"))
+    # new-timeline saves accumulate normally under `keep` again
+    ck.save(120, state, wait=True)
+    assert sorted(ck._list(ck._SNAP_RE)) == [100, 110, 120]
+    ck.close()
+
+
+def test_epoch_weights_rollback_supersedes_stale_futures(tmp_path):
+    """Same timeline rule for per-epoch weights: re-saving epoch e deletes
+    later epochs so latest_weights() never restores a stale future."""
+    ck = Checkpointer(str(tmp_path), keep=4)
+    for e in range(4):
+        ck.save_weights_epoch(e, mk_state(seed=e).params)
+    ck.save_weights_epoch(1, mk_state(seed=41).params)
+    like = jax.device_get(mk_state().params)
+    params, epoch = ck.latest_weights(like)
+    assert epoch == 1
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        jax.device_get(mk_state(seed=41).params), params)
+    assert sorted(ck._list(ck._WEIGHT_RE)) == [0, 1]
+
+
+def test_validate_rejects_structure_mismatch(tmp_path):
+    """A leaf-count mismatch must be its own loud error, not a silent
+    zip truncation that leaves trailing leaves unvalidated."""
+    import pytest
+
+    from dtdl_tpu.ckpt.checkpoint import _validate_shapes
+
+    restored = {"a": np.zeros((2,)), "b": np.zeros((2,)), "c": np.zeros((2,))}
+    like = {"a": np.zeros((2,)), "b": np.zeros((2,))}
+    with pytest.raises(ValueError, match="structure"):
+        _validate_shapes(restored, like, "origin")
+
+
 def test_orbax_restore_rejects_architecture_mismatch(tmp_path):
     """The full-state orbax path validates shapes too: orbax's own restore
     hands back the stored shape silently (verified), so Checkpointer must
