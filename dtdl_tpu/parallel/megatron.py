@@ -950,6 +950,105 @@ def make_megatron_train_step(cfg: MegatronConfig, mesh: Mesh, optimizer):
     return jax.jit(mapped, donate_argnums=(0, 1))
 
 
+def make_megatron_eval_step(cfg: MegatronConfig, mesh: Mesh):
+    """Compiled 4D-parallel eval step: forward + metrics, no optimizer.
+
+    ``(params, tokens, targets, mask) -> {'loss', 'accuracy', 'n_tokens'}``
+    with the same ``P('data','seq')`` batch placement as training
+    (:func:`shard_lm_batch`).  Parity target: every reference script
+    evaluates — restore-then-evaluate (reference
+    tensorflow2/mnist_single.py:88-92) and the allreduced multi-node
+    evaluator (reference chainer/train_mnist_multi.py:101-104); this is the
+    4D engine's equivalent, so validation never needs an optimizer update
+    (the train step donates params/opt_state, which makes "step but ignore
+    the update" unusable for eval).
+
+    Runs the GPipe forward scan regardless of ``cfg.schedule`` — with no
+    backward pass 1F1B's interleaving buys nothing, and the forward-only
+    scan holds no activation stash.  The LM head is vocab-parallel like
+    training's (`_head_loss`): per-shard logits over the V/tp slice,
+    logsumexp/true-logit/argmax combined with one psum/pmax/pmin('model')
+    each, so full [.., V] logits never materialize when tp > 1.  Loss and
+    accuracy are masked global sums over ('data','seq') divided by the
+    psummed mask total — ragged tails (mask=0 padding) are exact, matching
+    the DP engines' sum-synced metrics.  The eval loss is the plain LM
+    cross entropy: the MoE balance aux is a *training* regularizer and is
+    deliberately not added to validation loss.
+    """
+    if cfg.n_stages != mesh.shape[PIPE]:
+        raise ValueError(
+            f"cfg.n_stages={cfg.n_stages} must equal mesh 'pipe' size "
+            f"{mesh.shape[PIPE]}")
+    specs = param_specs(cfg)
+    batch_spec = P(DATA, SEQ)
+
+    def eval_fn(params, tokens, targets, mask):
+        b_loc, s_loc = tokens.shape
+        n_micro = cfg.n_microbatches
+        emb = params["embed"]
+        x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq)
+        x_micro = x.reshape(n_micro, b_loc // n_micro, s_loc, cfg.d_model)
+        y, _ = _pipeline(cfg, params, x_micro, cos, sin)
+        y = y.reshape(b_loc, s_loc, cfg.d_model)
+        h = _rms(y, params["ln_f"]).astype(jnp.float32)
+
+        v = cfg.vocab_size
+        tp = lax.axis_size(MODEL)
+        if tp > 1 and v % tp == 0:
+            v_loc = v // tp
+            off = lax.axis_index(MODEL) * v_loc
+            emb_slice = lax.dynamic_slice_in_dim(emb, off, v_loc, 0)
+            logits = jnp.einsum("bsd,vd->bsv", h,
+                                emb_slice.astype(jnp.float32))
+            loc_max = jnp.max(logits, -1)
+            mx = lax.pmax(loc_max, MODEL)
+            se = lax.psum(jnp.sum(jnp.exp(logits - mx[..., None]), -1),
+                          MODEL)
+            lse = mx + jnp.log(se)
+            in_range = (targets >= off) & (targets < off + v_loc)
+            idx = jnp.clip(targets - off, 0, v_loc - 1)
+            true_logit = lax.psum(
+                jnp.where(in_range,
+                          jnp.take_along_axis(logits, idx[..., None],
+                                              -1)[..., 0],
+                          0.0), MODEL)
+            # global argmax with jnp.argmax's first-occurrence tie-break:
+            # shards whose local max hits the global max bid their local
+            # argmax (+vocab offset); everyone else bids the out-of-range
+            # sentinel V; pmin picks the lowest winning index
+            loc_arg = jnp.argmax(logits, -1).astype(jnp.int32) + off
+            pred = lax.pmin(jnp.where(loc_max == mx, loc_arg, v), MODEL)
+        else:
+            logits = jnp.einsum("bsd,vd->bsv", h, emb.astype(jnp.float32))
+            lse = jax.nn.logsumexp(logits, -1)
+            true_logit = jnp.take_along_axis(
+                logits, targets[..., None], -1)[..., 0]
+            pred = jnp.argmax(logits, -1).astype(jnp.int32)
+
+        loss_sum = lax.psum(jnp.sum((lse - true_logit) * mask), (DATA, SEQ))
+        correct = lax.psum(
+            jnp.sum((pred == targets).astype(jnp.float32) * mask),
+            (DATA, SEQ))
+        count = lax.psum(jnp.sum(mask), (DATA, SEQ))
+        denom = jnp.maximum(count, 1.0)
+        out = {"loss": loss_sum / denom, "accuracy": correct / denom,
+               "n_tokens": count}
+        # the replicated-head branch leaves the scalars MODEL-varying in
+        # vma type only (every shard computed the same value); pmean is the
+        # value-preserving demotion so out_specs P() is accepted
+        return {k: lax.pmean(s, MODEL)
+                if MODEL in (jax.typeof(s).vma or ()) else s
+                for k, s in out.items()}
+
+    mapped = jax.shard_map(
+        eval_fn, mesh=mesh,
+        in_specs=(specs, batch_spec, batch_spec, batch_spec),
+        out_specs={"loss": P(), "accuracy": P(), "n_tokens": P()},
+    )
+    return jax.jit(mapped)   # no donation: params are reused for training
+
+
 def init_optimizer(cfg: MegatronConfig, mesh: Mesh, optimizer, params):
     """Optimizer state placed with param-aligned shardings."""
     state = optimizer.init(params)

@@ -68,6 +68,11 @@ def main():
          help="resume from the latest snapshot in --out")
     flag(parser, "--ckpt-interval", type=int, default=0,
          help="snapshot every N steps (0 = only at the end)")
+    flag(parser, "--eval-interval", type=int, default=0,
+         help="run held-out validation every N steps (0 = only at the "
+              "end); reference parity: every reference script evaluates")
+    flag(parser, "--eval-batches", type=int, default=2,
+         help="validation batches per evaluation")
     args = parser.parse_args()
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")
@@ -105,8 +110,8 @@ def main():
 
     # seq_len+1 tokens per sequence so that the shifted inputs/targets both
     # span seq_len positions (the 'seq' mesh axis must divide them evenly)
-    train_tokens, _ = load_dataset(args.dataset, seq_len=args.seq_len + 1,
-                                   vocab_size=vocab)
+    train_tokens, test_tokens = load_dataset(
+        args.dataset, seq_len=args.seq_len + 1, vocab_size=vocab)
     if args.batch_size % shape["data"] or \
             (args.batch_size // shape["data"]) % args.microbatches:
         raise SystemExit("--batch-size must be divisible by data-axis size "
@@ -151,6 +156,32 @@ def main():
     B, S = args.batch_size, args.seq_len
     n_seqs = len(train_tokens)
     loss = float("nan")
+
+    # held-out validation on the 4D mesh: forward-only eval step, metrics
+    # allreduced exactly (reference parity: tensorflow2/mnist_single.py
+    # evaluates after restore; chainer/train_mnist_multi.py allreduces its
+    # evaluator) — token-weighted mean over --eval-batches batches
+    eval_step = M.make_megatron_eval_step(cfg, mesh)
+
+    def run_eval(step_no):
+        loss_sum = correct_sum = tok_sum = 0.0
+        for j in range(args.eval_batches):
+            take = np.arange(j * B, (j + 1) * B) % len(test_tokens)
+            toks = test_tokens[take]
+            vb = M.shard_lm_batch(mesh, {
+                "tokens": toks[:, :-1].astype(np.int32),
+                "targets": toks[:, 1:].astype(np.int32),
+                "mask": np.ones((B, S), np.float32),
+            })
+            m = eval_step(params, vb["tokens"], vb["targets"], vb["mask"])
+            n = float(m["n_tokens"])
+            loss_sum += float(m["loss"]) * n
+            correct_sum += float(m["accuracy"]) * n
+            tok_sum += n
+        reporter.report({"step": step_no,
+                         "val_loss": loss_sum / max(tok_sum, 1.0),
+                         "val_accuracy": correct_sum / max(tok_sum, 1.0),
+                         "val_tokens": tok_sum})
     try:
         for i in range(start_step, args.steps):
             take = np.arange(i * B, (i + 1) * B) % n_seqs
@@ -168,6 +199,8 @@ def main():
                 reporter.report({"step": i, "loss": float(loss),
                                  "mesh": str(shape),
                                  **{k: float(v) for k, v in metrics.items()}})
+            if args.eval_interval and done % args.eval_interval == 0:
+                run_eval(done)
             if ckpt and ((args.ckpt_interval and done % args.ckpt_interval
                           == 0) or done == args.steps):
                 ckpt.save(done, {"params": params, "opt_state": opt_state,
@@ -176,6 +209,8 @@ def main():
         if ckpt:
             ckpt.wait_until_finished()
             ckpt.close()
+    if not args.eval_interval or args.steps % args.eval_interval:
+        run_eval(args.steps)   # end-of-run validation (always)
     print(f"final loss {float(loss):.6f} at step {args.steps} "
           f"on mesh {shape}", flush=True)
 

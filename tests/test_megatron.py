@@ -42,7 +42,9 @@ def _rms(x, scale, eps=1e-6):
             * scale)
 
 
-def oracle_loss(cfg, params, tokens, targets, mask):
+def oracle_logits(cfg, params, tokens):
+    """Unsharded forward to final LM-head logits; also returns the summed
+    MoE balance aux (zero for dense) so oracle_loss shares this body."""
     emb = params["embed"]
     x = jnp.take(emb, tokens, axis=0)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq)
@@ -99,12 +101,30 @@ def oracle_loss(cfg, params, tokens, targets, mask):
 
     x = _rms(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, emb)
+    return logits, aux_total
+
+
+def oracle_loss(cfg, params, tokens, targets, mask):
+    M = cfg.n_microbatches
+    logits, aux_total = oracle_logits(cfg, params, tokens)
     lse = jax.nn.logsumexp(logits, -1)
     true_logit = jnp.take_along_axis(logits, targets[..., None], -1)[..., 0]
     ce = jnp.sum((lse - true_logit) * mask) / jnp.sum(mask)
     if cfg.n_experts:
         ce = ce + cfg.moe_aux_weight * aux_total / (cfg.n_layers * M)
     return ce
+
+
+def oracle_eval(cfg, params, tokens, targets, mask):
+    """Validation metrics of the same math: plain CE (no aux), token
+    accuracy, both masked sums over every position."""
+    logits, _ = oracle_logits(cfg, params, tokens)
+    lse = jax.nn.logsumexp(logits, -1)
+    true_logit = jnp.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    total = jnp.sum(mask)
+    correct = jnp.sum((jnp.argmax(logits, -1) == targets) * mask)
+    return {"loss": jnp.sum((lse - true_logit) * mask) / total,
+            "accuracy": correct / total, "n_tokens": total}
 
 
 # ---- tests -----------------------------------------------------------------
@@ -150,6 +170,46 @@ def test_4d_step_matches_oracle(devices, n_experts, schedule, dispatch):
     for a, b in zip(flat, flat_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("n_experts,dispatch", [
+    (0, "dense"), (4, "routed"),
+])
+def test_4d_eval_step_matches_oracle(devices, n_experts, dispatch):
+    """make_megatron_eval_step == the unsharded oracle's validation
+    metrics: plain CE (no MoE aux), token accuracy, mask-exact ragged
+    tails — the 4D engine's restore-then-evaluate parity (reference
+    tensorflow2/mnist_single.py:88-92, chainer/train_mnist_multi.py:101-104).
+    """
+    cfg = _cfg(n_experts=n_experts, moe_dispatch=dispatch,
+               capacity_factor=4.0)
+    mesh = M.build_4d_mesh(devices)
+    params_host = M.init_params(cfg, jax.random.PRNGKey(0))
+    batch_host = _batch(cfg)
+    # ragged tails: whole-row padding and a mid-row cutoff must both be
+    # excluded exactly from loss, accuracy, and the token count
+    batch_host["mask"][:, -5:] = 0.0
+    batch_host["mask"][0, 3:] = 0.0
+
+    ref = oracle_eval(cfg, params_host, jnp.asarray(batch_host["tokens"]),
+                      jnp.asarray(batch_host["targets"]),
+                      jnp.asarray(batch_host["mask"]))
+
+    eval_step = M.make_megatron_eval_step(cfg, mesh)
+    params = M.place_params(mesh, cfg, params_host)
+    batch = M.shard_lm_batch(mesh, batch_host)
+    got = eval_step(params, batch["tokens"], batch["targets"],
+                    batch["mask"])
+
+    np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(got["accuracy"]),
+                               float(ref["accuracy"]), atol=1e-6)
+    assert float(got["n_tokens"]) == float(ref["n_tokens"])
+    # eval must not touch params (no donation, no update)
+    got2 = eval_step(params, batch["tokens"], batch["targets"],
+                     batch["mask"])
+    assert float(got2["loss"]) == float(got["loss"])
 
 
 def test_4d_step_loss_decreases(devices):
