@@ -415,6 +415,15 @@ def transformer_lm(size: str = "tiny", **overrides) -> TransformerLM:
                       d_ff=704, max_seq=1024),
         "base": dict(vocab_size=32000, d_model=512, n_layers=8, n_heads=4,
                      d_ff=1408, max_seq=2048),
+        # 'large' cashes LM_ROOFLINE.md §5's conclusion that further MFU
+        # comes from model shape: d_model 1024 doubles every matmul's
+        # contraction depth vs 'base' (same head_dim-128 MXU layout), and
+        # ~239M params at seq 4096 need the standard long-seq memory
+        # discipline — remat'd blocks plus the vocab-chunked loss
+        # (pass vocab_chunk_size to make_lm_train_step; the [B,S,32k] f32
+        # logits alone would be 4.2 GB at bs8/seq4096)
+        "large": dict(vocab_size=32000, d_model=1024, n_layers=16,
+                      n_heads=8, d_ff=2816, max_seq=2048, remat=True),
     }
     cfgs["small-hd128"] = cfgs["small"]
     cfgs["base-hd128"] = cfgs["base"]

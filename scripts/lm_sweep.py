@@ -20,9 +20,10 @@ from dtdl_tpu.parallel import choose_strategy
 from dtdl_tpu.train import init_state, make_lm_train_step
 
 
-def bench(size, bs, seq, chunk, iters=30, warmup=5):
+def bench(size, bs, seq, chunk, remat=None, iters=30, warmup=5):
     strategy = choose_strategy("auto")
-    model = transformer_lm(size, max_seq=seq)
+    overrides = {} if remat is None else {"remat": remat}
+    model = transformer_lm(size, max_seq=seq, **overrides)
     tx = optax.adamw(3e-4)
     state = strategy.replicate(init_state(
         model, jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32), tx))
@@ -52,6 +53,7 @@ def bench(size, bs, seq, chunk, iters=30, warmup=5):
     peak = peak_flops_per_chip()
     row = {
         "size": size, "bs": bs, "seq": seq, "chunk": chunk,
+        "remat": model.remat,
         "step_ms": round(step_ms, 3),
         "tokens_per_sec": round(bs * (seq - 1) * iters / dt, 0),
         "xla_flops": xla_flops, "analytic_flops": af,
@@ -70,8 +72,20 @@ if __name__ == "__main__":
         ("base", 16, 4096, 4096),
         ("base", 32, 4096, 4096),
         ("base", 32, 2048, 4096),
+        # round-5 'large' sweep (LM_ROOFLINE.md §6): remat off fits at
+        # bs 4 and wins; the preset default (remat=True) shown at bs 8
+        ("large", 4, 4096, 0, False),
+        ("large", 4, 4096, 4096, False),
+        ("large", 8, 4096, 4096, False),
+        ("large", 8, 4096, 4096, True),
     ]
-    if len(sys.argv) > 1:
+    if len(sys.argv) > 1 and sys.argv[1] == "--size":
+        if len(sys.argv) < 3:
+            raise SystemExit("--size needs a value (small/base/large)")
+        configs = [c for c in configs if c[0] == sys.argv[2]]
+        if not configs:
+            raise SystemExit(f"no sweep configs for size {sys.argv[2]!r}")
+    elif len(sys.argv) > 1:
         idx = [int(x) for x in sys.argv[1].split(",")]
         configs = [configs[i] for i in idx]
     for c in configs:
