@@ -9,8 +9,10 @@ model zoo gains a modern decoder-only LM:
 * causal **flash attention** via the Pallas TPU kernel
   (dtdl_tpu/ops/attention.py); ``attn_impl='dense'`` selects the reference
   einsum path for numerics tests
-* optional **mixture-of-experts** MLP (top-1 switch routing, XLA-friendly
-  dense dispatch via one-hot einsum — no dynamic shapes)
+* optional **mixture-of-experts** MLP — dense top-1 one-hot dispatch (the
+  numerics oracle) or GShard-style routed capacity-factor top-k (the
+  scale path: static-shape dispatch einsums GSPMD partitions over an
+  'expert' mesh axis; see :class:`MoE`)
 * every parameter is annotated with flax *logical axes* so the same module
   runs replicated, FSDP, or tensor-parallel under pjit by flipping the
   logical→mesh rules (dtdl_tpu/parallel/tensor.py)
@@ -160,34 +162,63 @@ class SwiGLU(nn.Module):
 
 
 class MoE(nn.Module):
-    """Top-1 switch MLP with dense one-hot dispatch (static shapes).
+    """Mixture-of-experts MLP with two XLA-friendly dispatch modes.
 
-    Router picks one expert per token; dispatch/combine are einsums against a
-    one-hot mask, so XLA sees fixed-shape batched matmuls it can put on the
-    MXU and partition over an 'expert' mesh axis.  A load-balancing auxiliary
-    loss is stashed via ``self.sow`` under 'aux_loss'.
+    ``dispatch='dense'`` (the numerics oracle): top-1 routing through a
+    one-hot einsum — every device computes every expert's einsum over all
+    tokens, O(E · tokens · D · F) FLOPs.  Fine for tests and small E;
+    useless at scale.
+
+    ``dispatch='routed'`` (the GSPMD scale path): GShard-style
+    capacity-factor top-k.  Each batch row is a routing group with
+    ``C = ceil(cf · S · k / E)`` slots per expert; assignments fill
+    choice-major (every first choice before any second choice, matching
+    the megatron engine's routed dispatch, parallel/megatron.py:286-392),
+    tokens past capacity are dropped (their residual passes through).
+    Dispatch/combine are one-hot einsums to a fixed [E, B, C, D] expert
+    buffer — static shapes throughout, so under the 'tp'/'tp_fsdp'
+    logical rules (parallel/tensor.py) the expert dim shards on 'model'
+    and XLA's partitioner inserts the token all-to-all; expert FFN FLOPs
+    drop to O(cf · k · tokens · D · F), E-independent.
+
+    Both modes share identical parameters (router/wi/wg/wo), so a dense
+    checkpoint loads into a routed model and, with ``capacity_factor >=
+    n_experts / top_k`` (nothing droppable), routed computes the same
+    function as dense top-1 — the oracle-equality contract the tests pin.
+
+    A Switch load-balance aux (E · <f, p>, first-choice counts) is
+    stashed via ``self.sow`` under 'aux_loss'; the LM train step adds it
+    to the loss (train/step.py:make_lm_train_step).
     """
     n_experts: int
     d_ff: int
     dtype: Dtype = jnp.bfloat16
+    dispatch: str = "dense"       # 'dense' | 'routed'
+    capacity_factor: float = 1.25
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
+        if not 1 <= self.top_k <= self.n_experts:
+            # same guard as the megatron engine's MegatronConfig: top_k=0
+            # would silently zero every MoE output, top_k > E dies deep in
+            # lax.top_k with an opaque trace error
+            raise ValueError(f"top_k={self.top_k} must be in "
+                             f"[1, n_experts={self.n_experts}]")
         b, s, d_model = x.shape
         router = nn.Dense(self.n_experts, use_bias=False, dtype=jnp.float32,
                           kernel_init=_part(nn.initializers.lecun_normal(),
                                             "embed", "expert"),
                           name="router")(x.astype(jnp.float32))
         probs = jax.nn.softmax(router, axis=-1)          # [b, s, E]
-        idx = jnp.argmax(probs, axis=-1)
-        onehot = jax.nn.one_hot(idx, self.n_experts, dtype=jnp.float32)
-        gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)
+        onehot1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1),
+                                 self.n_experts, dtype=jnp.float32)
 
-        # load-balance aux loss (Switch Transformer): E * <f, p>
-        frac_tokens = onehot.mean(axis=(0, 1))
-        frac_probs = probs.mean(axis=(0, 1))
+        # load-balance aux loss (Switch Transformer): E * <f, p> over the
+        # first choice — identical formula for both dispatch modes
         self.sow("aux_loss", "moe",
-                 self.n_experts * jnp.sum(frac_tokens * frac_probs))
+                 self.n_experts * jnp.sum(onehot1.mean(axis=(0, 1))
+                                          * probs.mean(axis=(0, 1))))
 
         def expert_param(name, shape, in_ax, out_ax):
             # batch_axis keeps the expert dim out of fan_in so every expert
@@ -203,12 +234,59 @@ class MoE(nn.Module):
         w_out = expert_param("wo", (self.n_experts, self.d_ff, d_model),
                              "mlp", "embed").astype(self.dtype)
 
+        if self.dispatch == "routed":
+            return self._routed(x, probs, w_in, w_gate, w_out)
+        if self.dispatch != "dense":
+            raise ValueError(f"unknown MoE dispatch {self.dispatch!r}")
+
+        gate = jnp.sum(probs * onehot1, axis=-1, keepdims=True)
         # dense dispatch: xe[e, b, s, d] = onehot[b, s, e] * x[b, s, d]
-        xe = jnp.einsum("bse,bsd->ebsd", onehot.astype(self.dtype), x)
+        xe = jnp.einsum("bse,bsd->ebsd", onehot1.astype(self.dtype), x)
         h = nn.silu(jnp.einsum("ebsd,edf->ebsf", xe, w_gate)) * \
             jnp.einsum("ebsd,edf->ebsf", xe, w_in)
         y = jnp.einsum("ebsf,efd->bsd", h, w_out)
         return y * gate.astype(self.dtype)
+
+    def _routed(self, x, probs, w_in, w_gate, w_out):
+        """Capacity-factor top-k dispatch (see class docstring)."""
+        import math
+        b, s, d_model = x.shape
+        E, k = self.n_experts, self.top_k
+        C = min(s, int(math.ceil(self.capacity_factor * s * k / E)))
+
+        gates, idx = jax.lax.top_k(probs, k)             # [b, s, k]
+        if k > 1:
+            # GShard-style renormalization over the chosen k (top-1 keeps
+            # the raw softmax prob — Switch semantics, == dense mode)
+            gates = gates / jnp.maximum(
+                jnp.sum(gates, -1, keepdims=True), 1e-9)
+
+        dispatch = jnp.zeros((b, s, E, C), jnp.float32)
+        combine = jnp.zeros((b, s, E, C), jnp.float32)
+        taken = jnp.zeros((b, 1, E), jnp.float32)        # slots used so far
+        for j in range(k):                               # choice-major fill
+            m = jax.nn.one_hot(idx[:, :, j], E, dtype=jnp.float32)
+            pos = jnp.cumsum(m, axis=1) - m + taken      # [b, s, E]
+            keep = m * (pos < C)
+            slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                                  dtype=jnp.float32)     # [b, s, E, C]
+            d_j = keep[..., None] * slot
+            dispatch = dispatch + d_j
+            combine = combine + gates[:, :, j, None, None] * d_j
+            taken = taken + jnp.sum(m, axis=1, keepdims=True)
+
+        # [E, B, C, D] expert buffers: 'expert' leads so the logical rules
+        # shard it on 'model' and GSPMD inserts the token all-to-all
+        xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(self.dtype), x)
+        xe = nn.with_logical_constraint(
+            xe, ("expert", "batch", None, "embed"))
+        h = nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, w_gate)) * \
+            jnp.einsum("ebcd,edf->ebcf", xe, w_in)
+        y = jnp.einsum("ebcf,efd->ebcd", h, w_out)
+        y = nn.with_logical_constraint(
+            y, ("expert", "batch", None, "embed"))
+        return jnp.einsum("ebcd,bsec->bsd", y,
+                          combine.astype(self.dtype))
 
 
 class Block(nn.Module):
@@ -218,6 +296,9 @@ class Block(nn.Module):
     n_experts: int = 0
     attn_impl: str = "flash"
     dtype: Dtype = jnp.bfloat16
+    moe_dispatch: str = "dense"
+    capacity_factor: float = 1.25
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, x, cos, sin, decode: bool = False):
@@ -228,7 +309,9 @@ class Block(nn.Module):
         h = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
         if self.n_experts > 0:
             x = x + MoE(self.n_experts, self.d_ff, self.dtype,
-                        name="moe")(h)
+                        dispatch=self.moe_dispatch,
+                        capacity_factor=self.capacity_factor,
+                        top_k=self.moe_top_k, name="moe")(h)
         else:
             x = x + SwiGLU(self.d_ff, self.dtype, name="mlp")(h)
         return x
@@ -244,6 +327,9 @@ class TransformerLM(nn.Module):
     max_seq: int = 2048
     n_experts: int = 0            # 0 = dense SwiGLU MLP
     moe_every: int = 2            # every k-th block is MoE (when n_experts>0)
+    moe_dispatch: str = "dense"   # 'dense' oracle | 'routed' capacity top-k
+    capacity_factor: float = 1.25  # routed: slots = ceil(cf * S * k / E)
+    moe_top_k: int = 1            # routed: experts per token
     attn_impl: str = "flash"
     remat: bool = False
     dtype: Dtype = jnp.bfloat16
@@ -286,6 +372,9 @@ class TransformerLM(nn.Module):
                 self.n_heads, self.head_dim, self.d_ff,
                 n_experts=self.n_experts if moe else 0,
                 attn_impl=self.attn_impl, dtype=self.dtype,
+                moe_dispatch=self.moe_dispatch,
+                capacity_factor=self.capacity_factor,
+                moe_top_k=self.moe_top_k,
                 name=f"block_{i}")
             # only pass the flag when set: a kwarg through nn.remat is
             # traced, and Attention branches on it in Python

@@ -16,6 +16,16 @@ Rule presets:
                   'data'; XLA all-gathers params per layer and
                   reduce-scatters grads.
 * ``tp_fsdp``   — both: 'model' for width, 'data' for the embed dim.
+* ``ep``        — expert parallelism for routed-MoE models: the 'expert'
+                  dim on 'model' (each shard owns E/tp experts; GSPMD
+                  inserts the token all-to-all around the dispatch
+                  einsums), attention heads + vocab still on 'model',
+                  FFN hidden unsharded — the megatron engine's ep-on-tp
+                  layout, compiler-partitioned.  (Under ``tp``, 'expert'
+                  and 'mlp' both name 'model' and flax resolves the
+                  conflict toward 'mlp': every expert's FFN is
+                  tensor-sharded instead — also valid, but EP is what
+                  lets E scale past one device's memory.)
 
 The reference has no model parallelism at all (SURVEY §2.2: TP/PP marked
 absent); this is part of the framework's beyond-parity scale path.
@@ -50,6 +60,11 @@ RULE_PRESETS = {
         ("batch", DATA_AXIS),
         ("vocab", MODEL_AXIS), ("embed", DATA_AXIS), ("heads", MODEL_AXIS),
         ("head_dim", None), ("mlp", MODEL_AXIS), ("expert", MODEL_AXIS),
+    ),
+    "ep": (
+        ("batch", DATA_AXIS),
+        ("vocab", MODEL_AXIS), ("embed", None), ("heads", MODEL_AXIS),
+        ("head_dim", None), ("mlp", None), ("expert", MODEL_AXIS),
     ),
 }
 

@@ -48,6 +48,15 @@ def main():
               "so large-vocab models fit at long sequence")
     flag(parser, "--n-experts", type=int, default=0,
          help=">0: switch-MoE MLPs with this many experts")
+    flag(parser, "--moe-dispatch", default="dense",
+         choices=["dense", "routed"],
+         help="MoE dispatch: dense one-hot oracle, or GShard-style "
+              "capacity-factor top-k (the scale path — same flag surface "
+              "as train_lm_4d.py)")
+    flag(parser, "--capacity-factor", type=float, default=1.25,
+         help="routed: per-expert slots = ceil(cf * seq * k / n_experts)")
+    flag(parser, "--moe-top-k", type=int, default=1,
+         help="routed: experts per token (1 = Switch, 2 = GShard top-2)")
     flag(parser, "--moe-aux-weight", type=float, default=0.01,
          help="Switch load-balance aux loss weight (added to the "
               "training loss; 0 disables)")
@@ -67,7 +76,10 @@ def main():
 
     train_tokens, _ = load_dataset(args.dataset, seq_len=args.seq_len)
     model = transformer_lm(args.model_size, max_seq=args.seq_len,
-                           attn_impl=args.attn, n_experts=args.n_experts)
+                           attn_impl=args.attn, n_experts=args.n_experts,
+                           moe_dispatch=args.moe_dispatch,
+                           capacity_factor=args.capacity_factor,
+                           moe_top_k=args.moe_top_k)
     if train_tokens.max() >= model.vocab_size:
         raise SystemExit("dataset vocab exceeds model vocab")
 

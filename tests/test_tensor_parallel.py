@@ -126,6 +126,55 @@ def test_fsdp_actually_shards_and_gathers(devices):
     assert "all-gather" in hlo, "fsdp step compiled without all-gather"
 
 
+def test_routed_moe_trains_sharded_and_matches_replicated(devices):
+    """The GSPMD face can train a REAL MoE: routed capacity top-k
+    dispatch under the 'tp' rules — expert weights physically sharded on
+    'model' (each shard holds E/tp experts), losses identical to the
+    replicated run, and (capacity permitting) to the dense-dispatch
+    oracle: XLA's partitioning of the all-to-all dispatch einsums must
+    not change the math."""
+    mesh = build_mesh(shape=(2, 4), axes=("data", "model"),
+                      devices=devices)
+    tx = optax.adamw(1e-3)
+    toks0 = jnp.zeros((1, 32), jnp.int32)
+    batch = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(0, 256, (8, 33)),
+                    jnp.int32),
+        NamedSharding(mesh, P("data")))
+
+    def losses(dispatch, rules, n=3):
+        model = transformer_lm(
+            "tiny", attn_impl="dense", dtype=jnp.float32, n_experts=4,
+            moe_every=1, moe_dispatch=dispatch, capacity_factor=4.0)
+        params, opt_state, sh = T.init_sharded_lm(model, mesh, tx, toks0,
+                                                  rules=rules)
+        step = T.make_sharded_lm_train_step(model, mesh, tx, sh)
+        out = []
+        for _ in range(n):
+            params, opt_state, loss = step(params, opt_state, batch)
+            out.append(float(loss))
+        return out, params
+
+    ref, _ = losses("routed", "replicated")
+    got, params = losses("routed", "ep")
+    np.testing.assert_allclose(got, ref, rtol=2e-4)
+    assert ref[-1] < ref[0]            # it actually trains
+    # under plain 'tp' the conflict resolves to per-expert FFN sharding
+    # (see RULE_PRESETS docstring) — the math must be identical there too
+    tp_losses, _ = losses("routed", "tp")
+    np.testing.assert_allclose(tp_losses, ref, rtol=2e-4)
+
+    # expert dim physically partitioned over 'model' (4-way): each device
+    # holds 1 of the 4 experts' [D, F] slabs
+    wi = params["block_0"]["moe"]["wi"]
+    assert wi.sharding.spec[0] == "model", wi.sharding.spec
+    assert wi.addressable_shards[0].data.shape[0] == wi.shape[0] // 4
+
+    # nothing droppable at cf=4/top-1 -> routed == the dense oracle
+    oracle, _ = losses("dense", "replicated")
+    np.testing.assert_allclose(got, oracle, rtol=2e-4)
+
+
 def test_autosharded_per_leaf_spec_through_train_step(devices):
     """AutoSharded(param_spec=<callable>) end-to-end through
     make_train_step: kernels shard on 'model', biases/step replicate, the

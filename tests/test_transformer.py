@@ -46,6 +46,56 @@ def test_moe_runs_and_sows_aux_loss():
     assert aux and all(float(a) >= 0 for a in aux)
 
 
+def test_routed_moe_matches_dense_when_nothing_drops():
+    """Routed capacity dispatch computes the identical function to the
+    dense one-hot oracle when no token can be dropped (capacity_factor =
+    n_experts at top-1 gives every expert a full-sequence buffer) — the
+    two modes share parameters, so the same init is applied to both."""
+    toks = _tokens()
+    dense = transformer_lm("tiny", n_experts=4, moe_every=1,
+                           attn_impl="dense", dtype=jnp.float32)
+    routed = transformer_lm("tiny", n_experts=4, moe_every=1,
+                            attn_impl="dense", dtype=jnp.float32,
+                            moe_dispatch="routed", capacity_factor=4.0)
+    vars_ = dense.init(jax.random.PRNGKey(0), toks)
+    out_d, aux_d = dense.apply(vars_, toks, mutable=["aux_loss"])
+    out_r, aux_r = routed.apply(vars_, toks, mutable=["aux_loss"])
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_r),
+                               atol=2e-5, rtol=1e-5)
+    # identical routing statistics -> identical balance aux
+    for a, b in zip(jax.tree.leaves(aux_d["aux_loss"]),
+                    jax.tree.leaves(aux_r["aux_loss"])):
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+
+def test_routed_moe_capacity_drops_and_top2():
+    """Tight capacity must drop overflow tokens (output falls back to the
+    residual = zero MoE contribution for them), and top-2 must produce
+    renormalized two-expert mixtures — both paths finite and trainable."""
+    from dtdl_tpu.models.transformer import MoE
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 8)), jnp.float32)
+
+    def apply(cf, k):
+        m = MoE(n_experts=4, d_ff=16, dtype=jnp.float32,
+                dispatch="routed", capacity_factor=cf, top_k=k)
+        v = m.init(jax.random.PRNGKey(1), x)
+        y, _ = m.apply(v, x, mutable=["aux_loss"])
+        return np.asarray(y)
+
+    full = apply(4.0, 1)
+    tight = apply(0.25, 1)     # C = ceil(0.25*16/4) = 1 slot per expert
+    assert np.isfinite(tight).all() and np.isfinite(full).all()
+    # overflow tokens lost their expert output: strictly more zero rows
+    zero_rows = lambda y: int((np.abs(y).max(-1) < 1e-12).sum())
+    assert zero_rows(tight) > zero_rows(full)
+    # top-2 differs from top-1 (second expert contributes) and is finite
+    two = apply(4.0, 2)
+    assert np.isfinite(two).all()
+    assert np.abs(two - full).max() > 1e-6
+
+
 def test_causality():
     """Changing a late token must not change earlier logits."""
     m = transformer_lm("tiny", attn_impl="dense", dtype=jnp.float32)
