@@ -93,6 +93,13 @@ class Attention(nn.Module):
                               "heads", "head_dim", "embed"),
             name="out")(o)
 
+    # prefill query rows are processed in blocks of this many: peak
+    # attention memory stays O(chunk * max_seq) instead of the
+    # O(prompt * max_seq) f32 logits a one-shot dense prefill would
+    # materialize per layer — the same memory bound the flash kernel
+    # gives training (advisor finding, round 4)
+    PREFILL_CHUNK = 256
+
     def _decode_attend(self, q, k, v, cos, sin):
         """Incremental attention against a KV cache ('cache' collection).
 
@@ -103,7 +110,9 @@ class Attention(nn.Module):
         row attends every cached position up to and including its own.
         Dense masked attention — decode is one query row against a cache,
         which is exactly the memory-light shape the flash kernel's tiling
-        is NOT for.  Mutate via ``apply(..., mutable=['cache'])``.
+        is NOT for; long prefills are chunked over query rows
+        (``PREFILL_CHUNK``) to keep the same O(seq) memory bound.
+        Mutate via ``apply(..., mutable=['cache'])``.
         """
         import math
         b, h, s_new, d = q.shape
@@ -131,15 +140,36 @@ class Attention(nn.Module):
             cv.value, v.astype(self.dtype), (0, 0, pos, 0))
         ci.value = pos + s_new
 
-        qpos = pos + jnp.arange(s_new)                      # [S]
-        mask = jnp.arange(max_len)[None, :] <= qpos[:, None]  # [S, max_len]
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
-                            preferred_element_type=jnp.float32)
-        logits = logits / math.sqrt(d)
-        logits = jnp.where(mask[None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(self.dtype),
-                          cv.value)
+        keys, values = ck.value, cv.value
+        scale = 1.0 / math.sqrt(d)
+
+        def attend(q_rows, qpos):
+            """[B, H, C, D] query rows at global positions qpos [C]."""
+            mask = jnp.arange(max_len)[None, :] <= qpos[:, None]
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q_rows, keys,
+                                preferred_element_type=jnp.float32)
+            logits = jnp.where(mask[None, None], logits * scale, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd",
+                              probs.astype(self.dtype), values)
+
+        chunk = self.PREFILL_CHUNK
+        if s_new <= chunk:
+            return attend(q, pos + jnp.arange(s_new))
+        # long prefill: pad the query rows to a chunk multiple and map
+        # over [n_chunks, B, H, chunk, D] blocks — the pad rows compute
+        # garbage (masked to a uniform softmax) and are sliced away
+        pad = -s_new % chunk
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        n_chunks = (s_new + pad) // chunk
+        q_blocks = jnp.moveaxis(
+            qp.reshape(b, h, n_chunks, chunk, d), 2, 0)
+        pos_blocks = (pos + jnp.arange(s_new + pad)).reshape(
+            n_chunks, chunk)
+        out = jax.lax.map(lambda args: attend(*args),
+                          (q_blocks, pos_blocks))
+        out = jnp.moveaxis(out, 0, 2).reshape(b, h, s_new + pad, d)
+        return out[:, :, :s_new]
 
 
 class SwiGLU(nn.Module):
@@ -205,6 +235,11 @@ class MoE(nn.Module):
             # lax.top_k with an opaque trace error
             raise ValueError(f"top_k={self.top_k} must be in "
                              f"[1, n_experts={self.n_experts}]")
+        if self.dispatch == "dense" and self.top_k != 1:
+            # dense dispatch is top-1 by construction; silently training
+            # top-1 when the user asked for top-2 would be invisible
+            raise ValueError("dense dispatch is top-1 only; top_k="
+                             f"{self.top_k} requires dispatch='routed'")
         b, s, d_model = x.shape
         router = nn.Dense(self.n_experts, use_bias=False, dtype=jnp.float32,
                           kernel_init=_part(nn.initializers.lecun_normal(),
@@ -275,8 +310,13 @@ class MoE(nn.Module):
             combine = combine + gates[:, :, j, None, None] * d_j
             taken = taken + jnp.sum(m, axis=1, keepdims=True)
 
-        # [E, B, C, D] expert buffers: 'expert' leads so the logical rules
-        # shard it on 'model' and GSPMD inserts the token all-to-all
+        # [E, B, C, D] expert buffers: 'expert' leads so that, under a
+        # caller-installed nn.logical_axis_rules context (e.g. the 'ep'
+        # preset via make_sharded_lm_train_step), the constraint pins the
+        # buffer's expert dim to its mesh axis and GSPMD inserts the
+        # token all-to-all; with no context installed the constraint is
+        # a no-op and the layout falls back to propagation from the
+        # weight shardings
         xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(self.dtype), x)
         xe = nn.with_logical_constraint(
             xe, ("expert", "batch", None, "embed"))
@@ -389,7 +429,7 @@ class TransformerLM(nn.Module):
 
 
 def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, rng=None, strategy=None):
     """Autoregressive generation with per-block KV caches.
 
     ``prompt``: int32 [B, S0] (S0 + max_new_tokens must fit
@@ -402,6 +442,17 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
     (model, shapes, temperature) so repeated calls don't re-trace.
     ``temperature=0`` is greedy argmax; otherwise samples from
     logits/temperature with ``rng``.
+
+    ``strategy``: a :class:`~dtdl_tpu.parallel.DataParallel` (or any
+    mesh strategy) scales decoding like training — the prompt is placed
+    batch-sharded on the data axis and XLA propagates that sharding
+    through the whole program, so every replica prefils and steps its
+    own batch rows with its own cache shards.  Tokens are IDENTICAL to
+    the single-device run: the computation is batch-elementwise, and
+    JAX's counter-based PRNG makes ``categorical`` draws depend only on
+    the global position, not the partitioning.  (jit re-specializes per
+    input sharding, so one compiled-program cache entry serves each
+    placement.)
 
     Returns int32 [B, S0 + max_new_tokens].  (The reference has no
     sequence models, let alone inference — SURVEY §5.7; this is part of
@@ -418,6 +469,8 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
     rng = jax.random.PRNGKey(0) if rng is None else rng
+    if strategy is not None:
+        prompt = strategy.shard_batch(jnp.asarray(prompt))
     run = _compiled_generate(model, b, s0, max_new_tokens, temperature)
     return run(params, prompt, rng)
 

@@ -109,7 +109,8 @@ def init_sharded_lm(model, mesh: Mesh, tx, example_tokens, rules="tp",
     return params, opt_state, (param_sh, opt_sh)
 
 
-def make_sharded_lm_train_step(model, mesh: Mesh, tx, shardings):
+def make_sharded_lm_train_step(model, mesh: Mesh, tx, shardings,
+                               rules="tp"):
     """pjit'd LM step with GSPMD-inserted collectives.
 
     ``batch`` {'tokens': int32 [B, S]} is sharded P('data') on the batch dim;
@@ -117,7 +118,19 @@ def make_sharded_lm_train_step(model, mesh: Mesh, tx, shardings):
     FSDP rules make XLA all-gather/reduce-scatter parameters around each use.
     Uses dense attention (einsums partition cleanly under GSPMD; the Pallas
     flash kernel pairs with the shard_map strategies instead).
+
+    ``rules`` (same preset/list as :func:`init_sharded_lm` — pass the one
+    the params were initialized with) is installed as the flax
+    ``logical_axis_rules`` context around the forward, so the model's
+    ``nn.with_logical_constraint`` annotations (e.g. the routed MoE's
+    [E, B, C, D] expert buffer pinning 'expert' to its mesh axis) bind to
+    real mesh axes instead of silently no-opping — without the context,
+    intermediate layouts would rely entirely on XLA's propagation from
+    the weight shardings.
     """
+    if isinstance(rules, str):
+        rules = RULE_PRESETS[rules]
+    rules = list(rules)
     param_sh, opt_sh = shardings
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -125,7 +138,9 @@ def make_sharded_lm_train_step(model, mesh: Mesh, tx, shardings):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, inputs).astype(jnp.float32)
+            with nn.logical_axis_rules(rules):
+                logits = model.apply(
+                    {"params": p}, inputs).astype(jnp.float32)
             lse = jax.nn.logsumexp(logits, -1)
             true = jnp.take_along_axis(
                 logits, targets[..., None].astype(jnp.int32), -1)[..., 0]

@@ -122,10 +122,22 @@ def main():
     # flag combination, OOM) must never discard the trained weights
     if args.generate_tokens:
         from dtdl_tpu.models import generate
-        prompt = jnp.asarray(train_tokens[:1, :8], jnp.int32)
-        params = jax.device_get(state.params)   # host view of (replicated)
-        out = generate(model, params, prompt,
-                       max_new_tokens=args.generate_tokens)
+        if jax.process_count() == 1:
+            # one prompt row per replica: the decode itself runs under
+            # the training strategy (batch-sharded caches), like training
+            n_rows = max(1, strategy.num_replicas)
+            prompt = jnp.asarray(train_tokens[:n_rows, :8], jnp.int32)
+            out = generate(model, state.params, prompt,
+                           max_new_tokens=args.generate_tokens,
+                           strategy=strategy)
+        else:
+            # multi-host: shard_batch would treat the prompt as this
+            # host's contribution to a process-spanning global array
+            # (batch x process_count vs the compiled cache shapes, and a
+            # non-addressable output) — decode host-locally instead
+            prompt = jnp.asarray(train_tokens[:1, :8], jnp.int32)
+            out = generate(model, jax.device_get(state.params), prompt,
+                           max_new_tokens=args.generate_tokens)
         print("generated:", np.asarray(out)[0].tolist(), flush=True)
 
 

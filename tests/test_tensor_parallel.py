@@ -24,7 +24,7 @@ def _setup(devices, rules):
     toks = jnp.zeros((1, 32), jnp.int32)
     params, opt_state, sh = T.init_sharded_lm(model, mesh, tx, toks,
                                               rules=rules)
-    step = T.make_sharded_lm_train_step(model, mesh, tx, sh)
+    step = T.make_sharded_lm_train_step(model, mesh, tx, sh, rules=rules)
     batch = jax.device_put(
         jnp.asarray(np.random.default_rng(0).integers(0, 256, (8, 33)),
                     jnp.int32),
@@ -148,7 +148,8 @@ def test_routed_moe_trains_sharded_and_matches_replicated(devices):
             moe_every=1, moe_dispatch=dispatch, capacity_factor=4.0)
         params, opt_state, sh = T.init_sharded_lm(model, mesh, tx, toks0,
                                                   rules=rules)
-        step = T.make_sharded_lm_train_step(model, mesh, tx, sh)
+        step = T.make_sharded_lm_train_step(model, mesh, tx, sh,
+                                            rules=rules)
         out = []
         for _ in range(n):
             params, opt_state, loss = step(params, opt_state, batch)
