@@ -321,6 +321,101 @@ def modeled_scaling(step_time_s: float, grad_bytes: float,
     return out
 
 
+# point-to-point ICI bandwidth (one neighbor link, one direction) — the
+# pp ppermute hops and the sp ring ride single links, unlike the
+# 2-link ring allreduce above
+ICI_P2P_BW = 45e9
+# fraction of the sequence-parallel ring traffic NOT hidden under the
+# per-chunk attention compute (the zigzag ring overlaps send/recv with
+# block attention by construction; 0.5 = half the hops exposed is the
+# conservative end measured for flash-block sizes on v5e-class chips)
+RING_EXPOSED = 0.5
+
+
+def modeled_scaling_4d(step_time_s: float, grad_bytes: float, *,
+                       d_model: int, n_layers: int, batch: int, seq: int,
+                       n_microbatches: int = 8, n_experts: int = 0,
+                       capacity_factor: float = 1.25,
+                       moe_every: int = 2,
+                       meshes=((1, 1, 1, 1), (1, 1, 1, 2), (1, 1, 1, 4),
+                               (1, 1, 1, 8), (1, 1, 2, 1), (1, 1, 4, 1),
+                               (1, 2, 2, 2), (2, 2, 2, 2),
+                               (1, 2, 2, 8))) -> dict:
+    """Strong-scaling model for the 4D megatron path (SCALING.md).
+
+    The DDP model above weak-scales a fixed per-chip batch; the 4D
+    engine's purpose is the opposite — split ONE model/batch over a
+    ('data','seq','pipe','model') mesh.  Per mesh (dp, sp, pp, tp):
+
+    * compute: ``t_step / n`` (the measured single-chip step divided
+      over all four axes), inflated by the 1F1B bubble
+      ``2(pp-1) / (M + 2(pp-1))``;
+    * tp: 4 activation allreduces per owned layer (2 fwd + 2 bwd,
+      Megatron column->row pairs) of the local [B/dp · S/sp, D] bf16
+      activations over the tp group (ring-allreduce cost);
+    * sp: the zigzag ring forwards each chip's K+V shard (sp-1) hops per
+      owned layer, ~3x for the backward's re-ring + dKV ring, over
+      single ICI links; ``RING_EXPOSED`` of it is not hidden under
+      block-attention compute;
+    * pp: each chip ppermutes every microbatch's boundary activations
+      once forward and once backward (single-link p2p);
+    * ep: routed MoE all-to-alls ``cf``-capacity token buffers to the
+      expert shards over 'model' — 2 (dispatch+combine) x 2 (fwd+bwd),
+      (tp-1)/tp of the tokens leave the chip — on every
+      ``moe_every``-th layer;
+    * dp: the grad allreduce of this chip's parameter shard
+      (``grad_bytes / (pp·tp)`` f32), overlap-windowed like the DDP
+      model.
+
+    Efficiency = ideal linear time / modeled time; (1,1,1,1) is exactly
+    the measured step (sanity anchor).  Constants: ICI_ALLREDUCE_BW,
+    ICI_P2P_BW, RING_EXPOSED, OVERLAP_FRAC/BWD_FRAC above.
+    """
+    out = {}
+    for dp, sp, pp, tp in meshes:
+        n = dp * sp * pp * tp
+        M = n_microbatches
+        act_bytes = batch * seq * d_model * 2 / (dp * sp)   # bf16, local
+        layers_owned = n_layers / pp
+
+        bubble = 2 * (pp - 1) / (M + 2 * (pp - 1)) if pp > 1 else 0.0
+        t_compute = step_time_s / n
+        t_pipe = t_compute / (1.0 - bubble)
+
+        t_tp = layers_owned * 4 * _allreduce_time(
+            act_bytes, tp, ICI_ALLREDUCE_BW)
+        # each of the (sp-1) ring rounds sends this chip's FULL K+V shard
+        # (2 * act_bytes — act_bytes is already the per-chip slice, so no
+        # (n-1)/n allreduce discount applies to p2p hops)
+        t_sp = (RING_EXPOSED * layers_owned * 3 * 2 * act_bytes
+                * (sp - 1) / ICI_P2P_BW) if sp > 1 else 0.0
+        t_pp = (2 * act_bytes / ICI_P2P_BW) if pp > 1 else 0.0
+        t_moe = 0.0
+        if n_experts and tp > 1:
+            moe_layers = layers_owned / moe_every
+            t_moe = (moe_layers * 4 * capacity_factor * act_bytes
+                     * (tp - 1) / tp / ICI_P2P_BW)
+        dp_grad = _allreduce_time(grad_bytes / (pp * tp), dp,
+                                  ICI_ALLREDUCE_BW)
+        window = OVERLAP_FRAC * BWD_FRAC * t_pipe
+        t_dp = max(0.0, dp_grad - window)
+
+        t_total = t_pipe + t_tp + t_sp + t_pp + t_moe + t_dp
+        out[f"{dp},{sp},{pp},{tp}"] = {
+            "chips": n,
+            "efficiency": round(t_compute / t_total, 4),
+            "speedup": round(step_time_s / t_total, 2),
+            "step_ms": round(t_total * 1e3, 3),
+            "comm_ms": {"tp": round(t_tp * 1e3, 3),
+                        "sp": round(t_sp * 1e3, 3),
+                        "pp": round(t_pp * 1e3, 3),
+                        "moe": round(t_moe * 1e3, 3),
+                        "dp_exposed": round(t_dp * 1e3, 3)},
+            "bubble": round(bubble, 4),
+        }
+    return out
+
+
 def _grad_bytes(model, example) -> float:
     """f32 gradient bytes of one replica (flax keeps params f32 under
     bf16 compute; DDP allreduces full-precision grads).  Only the
@@ -357,6 +452,13 @@ def scaling_section(records) -> dict:
             gb = _grad_bytes(model, ex)
             out[key] = {"grad_mbytes": round(gb / 1e6, 1),
                         **modeled_scaling(r["step_time_ms"] / 1e3, gb)}
+            if key == "lm_base_seq4096":
+                # the 4D engine's strong-scaling model, anchored on the
+                # same measured step (SCALING.md "The 4D model")
+                out["megatron_4d"] = modeled_scaling_4d(
+                    r["step_time_ms"] / 1e3, gb,
+                    d_model=model.d_model, n_layers=model.n_layers,
+                    batch=r["batch_size"], seq=r["seq"])
     if out:
         # sanity anchor: solving the (no-overlap) model for the
         # reference's published 4-GPU point — PyramidNet, 0.255 s/step,

@@ -33,13 +33,38 @@ def test_modeled_scaling_rejects_partial_hosts():
         bench.modeled_scaling(0.064, 97.2e6, chips=(12,))
 
 
+def test_modeled_scaling_4d_anchor_and_structure():
+    m = bench.modeled_scaling_4d(0.1266, 168.3e6, d_model=512, n_layers=8,
+                                 batch=8, seq=4096)
+    # the single-chip row IS the measured step: exact anchor
+    one = m["1,1,1,1"]
+    assert one["efficiency"] == 1.0 and one["speedup"] == 1.0
+    assert one["step_ms"] == 126.6
+    # every parallel axis pays its own toll
+    assert m["1,1,1,2"]["comm_ms"]["tp"] > 0
+    assert m["1,2,2,2"]["comm_ms"]["sp"] > 0
+    assert m["1,1,2,1"]["bubble"] == pytest.approx(2 / 10)  # 2(pp-1)/(M+2(pp-1))
+    # tp psum bytes don't shrink with tp: efficiency strictly decays
+    effs = [m[f"1,1,1,{tp}"]["efficiency"] for tp in (1, 2, 4, 8)]
+    assert effs == sorted(effs, reverse=True) and effs[-1] < 0.5
+    # speedup still grows (the point of scaling at all)
+    assert m["1,1,1,8"]["speedup"] > m["1,1,1,2"]["speedup"] > 1.0
+    # MoE all-to-all priced only when experts + tp exist
+    moe = bench.modeled_scaling_4d(
+        0.1266, 168.3e6, d_model=512, n_layers=8, batch=8, seq=4096,
+        n_experts=8, meshes=((1, 1, 1, 4), (1, 1, 4, 1)))
+    assert moe["1,1,1,4"]["comm_ms"]["moe"] > 0
+    assert moe["1,1,4,1"]["comm_ms"]["moe"] == 0.0
+
+
 def test_scaling_section_emits_headline_rows_and_sanity():
     rows = [{"model": "pyramidnet", "batch_size": 256, "step_time_ms": 63.8},
             {"model": "lm", "size": "base", "seq": 4096, "batch_size": 8,
              "step_time_ms": 126.7}]
     out = bench.scaling_section(rows)
     assert set(out) == {"pyramidnet_bs256", "lm_base_seq4096",
-                        "reference_4gpu_sanity"}
+                        "megatron_4d", "reference_4gpu_sanity"}
+    assert out["megatron_4d"]["1,1,1,1"]["efficiency"] == 1.0
     assert out["pyramidnet_bs256"]["grad_mbytes"] == 97.0   # params only, no BN stats
     # the model reproduces the reference's 4-GPU point with a physically
     # plausible effective bandwidth (unoverlapped PCIe-era allreduce)
