@@ -348,8 +348,9 @@ def modeled_scaling_4d(step_time_s: float, grad_bytes: float, *,
     ('data','seq','pipe','model') mesh.  Per mesh (dp, sp, pp, tp):
 
     * compute: ``t_step / n`` (the measured single-chip step divided
-      over all four axes), inflated by the 1F1B bubble
-      ``2(pp-1) / (M + 2(pp-1))``;
+      over all four axes), inflated by the segmented-1F1B bubble
+      ``(pp-1) / (M + pp - 1)`` (the Megatron 1F1B bound at v=1 —
+      megatron.bubble_fraction);
     * tp: 4 activation allreduces per owned layer (2 fwd + 2 bwd,
       Megatron column->row pairs) of the local [B/dp · S/sp, D] bf16
       activations over the tp group (ring-allreduce cost);
@@ -378,7 +379,7 @@ def modeled_scaling_4d(step_time_s: float, grad_bytes: float, *,
         act_bytes = batch * seq * d_model * 2 / (dp * sp)   # bf16, local
         layers_owned = n_layers / pp
 
-        bubble = 2 * (pp - 1) / (M + 2 * (pp - 1)) if pp > 1 else 0.0
+        bubble = (pp - 1) / (M + pp - 1) if pp > 1 else 0.0
         t_compute = step_time_s / n
         t_pipe = t_compute / (1.0 - bubble)
 

@@ -596,26 +596,33 @@ def n_pipeline_ticks(cfg: MegatronConfig) -> int:
 
 
 def bubble_fraction(cfg: MegatronConfig) -> float:
-    """Idle fraction of the (interleaved) 1F1B scan: 1 - M*v / n_ticks.
+    """Idle TIME fraction of the segmented (interleaved) 1F1B schedule.
 
-    Each tick carries one forward-chunk and one backward-chunk lane (1/v of
-    a stage's layers each), so useful lane-ticks are ``M*v`` of
-    ``n_pipeline_ticks``.  v=1 gives ``2(S-1) / (M + 2(S-1))``.  Raising v
-    shrinks the idle *time* toward ``~S(v+1)/(2v)`` chunk-times (half the
-    v=1 bubble asymptotically) — the lockstep two-lane scan can't reach
-    Megatron's 1/v interleaved bound, which needs per-device fwd/bwd slot
-    scheduling rather than SPMD lanes.
+    The scan is split into three segments (see `_value_and_grad_1f1b`):
+    ``vS-1`` forward-only warmup ticks (cost tf/v each), ``T - 2(vS-1)``
+    two-lane steady ticks ((tf+tb)/v), and ``vS-1`` backward-only
+    cooldown ticks (tb/v).  Useful work per device is ``M(tf+tb)``; the
+    excess idle time is exactly ``(S-1)(tf+tb)/v`` when M is a multiple
+    of S — **the Megatron interleaved-1F1B bubble bound** (v=1 reduces
+    to the classic 1F1B ``(S-1)/(M+S-1)`` fraction).  The earlier
+    two-lane lockstep scan paid (tf+tb)/v on every tick including warmup
+    and cooldown, capping at ~S(v+1)/(2v) chunk-times of idle;
+    segmenting removed that structural penalty without touching the
+    per-tick math.
+
+    The *fraction* is independent of the tf:tb ratio by construction:
+    warmup and cooldown have equal tick counts, so their combined cost
+    is ``(vS-1)(tf+tb)/v`` and the ``(tf+tb)`` factor cancels —
+    ``1 - Mv / (T - (vS-1))``.
 
     Relative to the GPipe path (`_loss_fn`): GPipe's scan runs M + S - 1
-    forward ticks and lets autodiff replay them backward, so its combined
-    idle fraction is *lower* per tick but its peak memory holds all M
-    microbatch activations; this schedule trades lockstep head/VJP
-    arithmetic on every stage for ``min(k_span, 2vS-1)`` saved chunk
-    inputs (k_span = M*v when M % S == 0)
-    and no cross-stage broadcast.
+    forward ticks and lets autodiff replay them backward; its peak memory
+    holds all M microbatch activations, while this schedule saves only
+    ``min(k_span, 2vS-1)`` chunk inputs (k_span = M*v when M % S == 0)
+    and needs no cross-stage broadcast.
     """
-    m, v = cfg.n_microbatches, cfg.virtual_stages
-    return 1.0 - (m * v) / n_pipeline_ticks(cfg)
+    S, m, v = cfg.n_stages, cfg.n_microbatches, cfg.virtual_stages
+    return 1.0 - m * v / (n_pipeline_ticks(cfg) - (v * S - 1))
 
 
 def _vary(x, axes):
@@ -669,10 +676,15 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
     """(loss, grads) via an explicit (interleaved) 1F1B schedule.  Inside
     shard_map.
 
-    One ``lax.scan`` over :func:`n_pipeline_ticks` ticks.  Per tick, every
-    device runs one forward *chunk* and one backward *chunk* (rematerialized
+    Three ``lax.scan`` segments totalling :func:`n_pipeline_ticks` ticks:
+    a forward-only warmup (vS-1 ticks), a two-lane steady phase, and a
+    backward-only cooldown (vS-1 ticks) — per steady tick, every device
+    runs one forward *chunk* and one backward *chunk* (rematerialized
     ``jax.vjp``), where a chunk is ``layers_per_stage / virtual_stages`` of
-    its layers.  With ``v = virtual_stages`` chunks per device the model is
+    its layers.  Segmenting prunes the provably-idle lane from the ramp
+    ticks, landing the schedule on the Megatron interleaved bubble bound
+    ``(S-1)(tf+tb)/v`` (`bubble_fraction`).  With ``v = virtual_stages``
+    chunks per device the model is
     a virtual pipeline of depth ``V = v*S`` whose hops always target the
     next/prev device on the 'pipe' ring (chunk c on device S-1 wraps to
     chunk c+1 on device 0), so the two ``ppermute``s per tick are unchanged
@@ -791,74 +803,129 @@ def _value_and_grad_1f1b(cfg: MegatronConfig, params, tokens, targets, mask):
         k = g * (v * S) + chunk * S + j
         return active, chunk, jnp.clip(m, 0, M - 1), jnp.maximum(k, 0)
 
-    def tick(carry, t):
-        # ---- forward lane: chunk c_f of microbatch m_f ------------------
-        f_active, c_f, m_idx, k_f = fwd_indices(t)
-        tok_f = lax.dynamic_index_in_dim(tok_micro, m_idx, 0, keepdims=False)
-        inject = jnp.take(params["embed"], tok_f, axis=0).astype(cfg.dtype)
-        x_in = jnp.where((stage == 0) & (c_f == 0), inject, carry["buf_f"])
-        slot_f = jnp.mod(k_f, n_slots)
-        old = lax.dynamic_index_in_dim(carry["x_saved"], slot_f, 0,
-                                       keepdims=False)
-        x_saved = lax.dynamic_update_index_in_dim(
-            carry["x_saved"], jnp.where(f_active, x_in, old), slot_f, 0)
-        p_f = chunk_params(c_f)
-        y, st = _stage_forward(cfg, p_f, x_in, cos, sin)
-        drop = carry["drop"] + jnp.where(f_active, st[0], 0.0)
-        tot = carry["tot"] + jnp.where(f_active, st[1], 0.0)
-        auxs = carry["auxs"] + jnp.where(f_active, st[2], 0.0)
+    def make_tick(do_fwd: bool, do_bwd: bool):
+        """One scan body specialized (at trace time) to its schedule
+        segment.  The two-lane lockstep body used to run for ALL ticks,
+        paying forward+backward chunk cost even through the warmup
+        (where every device's backward lane is provably idle: tb <=
+        t-(vS-1) < 0) and the cooldown (symmetrically, no forward lane
+        and no head anywhere).  Splitting the scan into fwd-only /
+        two-lane / bwd-only segments removes exactly that waste: per-tick
+        cost (tf+tb)/v only in the steady segment, tf/v in warmup, tb/v
+        in cooldown — total bubble (S-1)(tf+tb)/v, the Megatron
+        interleaved 1F1B bound (see `bubble_fraction`)."""
 
-        # ---- head on the final chunk's output (last device only) -------
-        tgt = lax.dynamic_index_in_dim(tgt_micro, m_idx, 0, keepdims=False)
-        msk = lax.dynamic_index_in_dim(msk_micro, m_idx, 0, keepdims=False)
-        loss_m, head_vjp = jax.vjp(
-            lambda e, lf, yy: _head_loss(cfg, e, lf, yy, tgt, msk, inv_total),
-            emb_v, lnf_v, y)
-        demb_m, dlnf_m, dy_head = head_vjp(
-            _vary(jnp.float32(1.0), jax.typeof(loss_m).vma or ()))
-        head_active = (stage == S - 1) & (c_f == v - 1) & f_active
-        loss = carry["loss"] + jnp.where(head_active, loss_m, 0.0)
-        demb = carry["demb"] + jnp.where(head_active, demb_m, 0.0)
-        dlnf = carry["dlnf"] + jnp.where(head_active, dlnf_m, 0.0)
+        def tick(carry, t):
+            x_saved = carry["x_saved"]
+            loss, demb, dlnf = carry["loss"], carry["demb"], carry["dlnf"]
+            drop, tot, auxs = carry["drop"], carry["tot"], carry["auxs"]
+            y = dy_head = None
+            if do_fwd:
+                # ---- forward lane: chunk c_f of microbatch m_f ----------
+                f_active, c_f, m_idx, k_f = fwd_indices(t)
+                tok_f = lax.dynamic_index_in_dim(tok_micro, m_idx, 0,
+                                                 keepdims=False)
+                inject = jnp.take(params["embed"], tok_f,
+                                  axis=0).astype(cfg.dtype)
+                x_in = jnp.where((stage == 0) & (c_f == 0), inject,
+                                 carry["buf_f"])
+                slot_f = jnp.mod(k_f, n_slots)
+                old = lax.dynamic_index_in_dim(x_saved, slot_f, 0,
+                                               keepdims=False)
+                x_saved = lax.dynamic_update_index_in_dim(
+                    x_saved, jnp.where(f_active, x_in, old), slot_f, 0)
+                p_f = chunk_params(c_f)
+                y, st = _stage_forward(cfg, p_f, x_in, cos, sin)
+                drop = drop + jnp.where(f_active, st[0], 0.0)
+                tot = tot + jnp.where(f_active, st[1], 0.0)
+                auxs = auxs + jnp.where(f_active, st[2], 0.0)
 
-        # ---- backward lane: chunk c_b of microbatch u_b -----------------
-        b_active, c_b, u_idx, k_b = bwd_indices(t)
-        x_b = lax.dynamic_index_in_dim(x_saved, jnp.mod(k_b, n_slots), 0,
-                                       keepdims=False)
-        dy = jnp.where((stage == S - 1) & (c_b == v - 1),
-                       dy_head, carry["buf_b"])
-        p_b = chunk_params(c_b)
-        (_, aux_b), chunk_vjp = jax.vjp(chunk_fn, p_b, x_b)
-        # the aux-loss cotangent rides the same rematerialized chunk vjp as
-        # the activation cotangent; inactive backward lanes get zero
-        aux_cot = jnp.where(b_active, jnp.float32(aux_cot_w), 0.0)
-        dw_m, dx = chunk_vjp((dy, _vary(aux_cot,
-                                        jax.typeof(aux_b).vma or ())))
+            if do_fwd and do_bwd:
+                # ---- head on the final chunk's output (last device) ----
+                # only the steady segment needs it: the first head fires
+                # at t = vS-1 (after warmup) and its dy is consumed by the
+                # SAME tick's backward lane, never later
+                tgt = lax.dynamic_index_in_dim(tgt_micro, m_idx, 0,
+                                               keepdims=False)
+                msk = lax.dynamic_index_in_dim(msk_micro, m_idx, 0,
+                                               keepdims=False)
+                loss_m, head_vjp = jax.vjp(
+                    lambda e, lf, yy: _head_loss(cfg, e, lf, yy, tgt, msk,
+                                                 inv_total),
+                    emb_v, lnf_v, y)
+                demb_m, dlnf_m, dy_head = head_vjp(
+                    _vary(jnp.float32(1.0), jax.typeof(loss_m).vma or ()))
+                head_active = (stage == S - 1) & (c_f == v - 1) & f_active
+                loss = loss + jnp.where(head_active, loss_m, 0.0)
+                demb = demb + jnp.where(head_active, demb_m, 0.0)
+                dlnf = dlnf + jnp.where(head_active, dlnf_m, 0.0)
 
-        def acc_chunk(a, d):
-            cur = lax.dynamic_slice_in_dim(a, c_b * Lc, Lc, 0)
-            return lax.dynamic_update_slice_in_dim(
-                a, cur + jnp.where(b_active, d, 0.0), c_b * Lc, 0)
+            dw, dx = carry["dw"], None
+            if do_bwd:
+                # ---- backward lane: chunk c_b of microbatch u_b ---------
+                b_active, c_b, u_idx, k_b = bwd_indices(t)
+                x_b = lax.dynamic_index_in_dim(
+                    x_saved, jnp.mod(k_b, n_slots), 0, keepdims=False)
+                dy = carry["buf_b"]
+                if dy_head is not None:
+                    dy = jnp.where((stage == S - 1) & (c_b == v - 1),
+                                   dy_head, dy)
+                p_b = chunk_params(c_b)
+                (_, aux_b), chunk_vjp = jax.vjp(chunk_fn, p_b, x_b)
+                # the aux-loss cotangent rides the same rematerialized
+                # chunk vjp as the activation cotangent; inactive backward
+                # lanes get zero
+                aux_cot = jnp.where(b_active, jnp.float32(aux_cot_w), 0.0)
+                dw_m, dx = chunk_vjp((dy, _vary(aux_cot,
+                                                jax.typeof(aux_b).vma
+                                                or ())))
 
-        dw = jax.tree.map(acc_chunk, carry["dw"], dw_m)
-        # input-embedding cotangent (scatter-add), device 0 chunk 0 only;
-        # pre-divided by tp so it can share the MODEL-psummed accumulator
-        tok_b = lax.dynamic_index_in_dim(tok_micro, u_idx, 0, keepdims=False)
-        _, embed_vjp = jax.vjp(
-            lambda e: jnp.take(e, tok_b, axis=0).astype(cfg.dtype), emb_v)
-        (demb_u,) = embed_vjp(_vary(dx, (MODEL,)))
-        demb = demb + jnp.where(
-            b_active & (stage == 0) & (c_b == 0), demb_u / tp, 0.0)
+                def acc_chunk(a, d):
+                    cur = lax.dynamic_slice_in_dim(a, c_b * Lc, Lc, 0)
+                    return lax.dynamic_update_slice_in_dim(
+                        a, cur + jnp.where(b_active, d, 0.0), c_b * Lc, 0)
 
-        # ---- ring handoffs ---------------------------------------------
-        new_carry = dict(
-            buf_f=lax.ppermute(y, PIPE, perm_up),
-            buf_b=lax.ppermute(dx, PIPE, perm_down),
-            x_saved=x_saved, dw=dw, demb=demb,
-            dlnf=dlnf, loss=loss, drop=drop, tot=tot, auxs=auxs)
-        return new_carry, None
+                dw = jax.tree.map(acc_chunk, dw, dw_m)
+                # input-embedding cotangent (scatter-add), device 0 chunk
+                # 0 only; pre-divided by tp so it can share the
+                # MODEL-psummed accumulator
+                tok_b = lax.dynamic_index_in_dim(tok_micro, u_idx, 0,
+                                                 keepdims=False)
+                _, embed_vjp = jax.vjp(
+                    lambda e: jnp.take(e, tok_b, axis=0).astype(cfg.dtype),
+                    emb_v)
+                (demb_u,) = embed_vjp(_vary(dx, (MODEL,)))
+                demb = demb + jnp.where(
+                    b_active & (stage == 0) & (c_b == 0), demb_u / tp, 0.0)
 
-    carry, _ = lax.scan(tick, carry0, jnp.arange(n_ticks))
+            # ---- ring handoffs (only the lanes that ran) ---------------
+            new_carry = dict(
+                buf_f=lax.ppermute(y, PIPE, perm_up)
+                if do_fwd else carry["buf_f"],
+                buf_b=lax.ppermute(dx, PIPE, perm_down)
+                if do_bwd else carry["buf_b"],
+                x_saved=x_saved, dw=dw, demb=demb,
+                dlnf=dlnf, loss=loss, drop=drop, tot=tot, auxs=auxs)
+            return new_carry, None
+
+        return tick
+
+    # schedule segments: warmup [0, vS-1) has no backward anywhere
+    # (tb = t-(vS-1)-(S-1-s) < 0 for every s), cooldown [fwd_end, T) has
+    # no forward anywhere (every device past its last microbatch) and no
+    # head (a head's dy is consumed the same tick it is produced) —
+    # n_pipeline_ticks = fwd_end + (vS-1), so the segments partition it
+    warm_end = v * S - 1
+    fwd_end = n_ticks - warm_end
+    carry = carry0
+    if warm_end:
+        carry, _ = lax.scan(make_tick(True, False), carry,
+                            jnp.arange(0, warm_end))
+    carry, _ = lax.scan(make_tick(True, True), carry,
+                        jnp.arange(warm_end, fwd_end))
+    if warm_end:
+        carry, _ = lax.scan(make_tick(False, True), carry,
+                            jnp.arange(fwd_end, n_ticks))
 
     # ---- combine cotangents into global-layout grads ---------------------
     demb = lax.psum(carry["demb"], (DATA, SEQ, PIPE, MODEL))

@@ -282,25 +282,30 @@ def test_1f1b_single_device_mesh(devices):
 
 
 def test_bubble_fraction():
-    # v=1: the classic 2(S-1)/(M+2(S-1)) idle fraction of this scan's two
-    # lockstep lanes (the GPipe path's own tick count differs — M+S-1
-    # forward ticks replayed by autodiff; see bubble_fraction's docstring)
+    # segmented schedule: idle time = (S-1)(tf+tb)/v exactly when S | M —
+    # the Megatron interleaved 1F1B bound (v=1: (S-1)/(M+S-1) fraction)
     assert M.bubble_fraction(_cfg(n_stages=1, n_microbatches=4)) == 0.0
-    assert M.bubble_fraction(_cfg(n_stages=2, n_microbatches=2)) == 0.5
+    # S=2, M=2: total = 1*tf + 2*(tf+tb) + 1*tb = 9, ideal 6 -> 1/3
+    assert abs(M.bubble_fraction(_cfg(n_stages=2, n_microbatches=2))
+               - 1 / 3) < 1e-12
+    # S=4, M=16: (S-1)/(M+S-1) = 3/19
     assert abs(M.bubble_fraction(_cfg(n_stages=4, n_microbatches=16))
-               - 6 / 22) < 1e-12
+               - 3 / 19) < 1e-12
 
 
 def test_interleaved_tick_count_and_bubble_drop():
-    """virtual_stages=v shrinks both the idle fraction and the
-    work-normalized schedule length (ticks/v, each tick = 1/v stage)."""
+    """virtual_stages=v shrinks the idle fraction toward the 1/v bound
+    (ticks stay chunk-sized: each costs 1/v of a stage)."""
     base = dict(n_stages=4, layers_per_stage=2, n_microbatches=8)
     v1 = _cfg(**base)
     v2 = _cfg(**base, virtual_stages=2)
     assert M.n_pipeline_ticks(v1) == 8 + 2 * 3          # M + 2(S-1)
     assert M.n_pipeline_ticks(v2) == 26                 # Mv + (v+1)S - 2
-    assert M.n_pipeline_ticks(v2) / 2 < M.n_pipeline_ticks(v1)
-    assert M.bubble_fraction(v2) < M.bubble_fraction(v1)
+    # bubble TIME halves at v=2: (S-1)*3/v = 4.5 vs 9 stage-units
+    b1, b2 = M.bubble_fraction(v1), M.bubble_fraction(v2)
+    assert abs(b1 - 9 / 33) < 1e-12     # 9 idle of 24+9
+    assert abs(b2 - 4.5 / 28.5) < 1e-12  # 4.5 idle of 24+4.5
+    assert b2 < b1
 
 
 def test_factor_mesh():

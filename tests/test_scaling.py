@@ -43,7 +43,8 @@ def test_modeled_scaling_4d_anchor_and_structure():
     # every parallel axis pays its own toll
     assert m["1,1,1,2"]["comm_ms"]["tp"] > 0
     assert m["1,2,2,2"]["comm_ms"]["sp"] > 0
-    assert m["1,1,2,1"]["bubble"] == pytest.approx(2 / 10)  # 2(pp-1)/(M+2(pp-1))
+    # (pp-1)/(M+pp-1); the emitted value is rounded to 4 decimals
+    assert m["1,1,2,1"]["bubble"] == pytest.approx(1 / 9, abs=1e-4)
     # tp psum bytes don't shrink with tp: efficiency strictly decays
     effs = [m[f"1,1,1,{tp}"]["efficiency"] for tp in (1, 2, 4, 8)]
     assert effs == sorted(effs, reverse=True) and effs[-1] < 0.5
