@@ -78,6 +78,12 @@ if __name__ == "__main__":
         ("large", 4, 4096, 4096, False),
         ("large", 8, 4096, 4096, False),
         ("large", 8, 4096, 4096, True),
+        # long-context rows (LM_ROOFLINE.md §7): MFU holds flat as seq
+        # doubles/quadruples at fixed tokens-per-step — the O(seq) flash
+        # memory bound in action
+        ("base", 4, 8192, 0, False),
+        ("base", 2, 16384, 4096, False),
+        ("large", 2, 8192, 0, False),
     ]
     if len(sys.argv) > 1 and sys.argv[1] == "--size":
         if len(sys.argv) < 3:
