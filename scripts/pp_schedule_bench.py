@@ -1,0 +1,101 @@
+"""Measure the segmented-1F1B schedule win against the old lockstep scan.
+
+The round-5 segmentation (megatron.py `_value_and_grad_1f1b`) claims the
+warmup/cooldown lanes the lockstep scan wasted are real cost:
+total (tf+tb)·T/v lockstep vs (tf+tb)·(T-(vS-1))/v segmented.  On this
+box the 8-device mesh is virtual (one CPU core executes every device's
+program serially), so wall-clock per step is proportional to TOTAL
+executed ops across devices — exactly the quantity segmentation
+reduces — making the single-core host a faithful scale model of the
+schedule's cost, if not of its latency.
+
+The old schedule is loaded from git history (commit 87ed655, the last
+lockstep revision) into a throwaway module so both versions run the
+IDENTICAL config in one process.  Expected ratio for S=4, M=4, v=1:
+lockstep 3·(M+2(S-1)) = 30 chunk-units vs segmented 30-9 = 21 → ~1.4x.
+
+Run:  python scripts/pp_schedule_bench.py
+"""
+import importlib.util
+import json
+import subprocess
+import sys
+import tempfile
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, "/root/repo")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+LOCKSTEP_REV = "87ed655"
+
+
+def load_old_megatron():
+    src = subprocess.run(
+        ["git", "-C", "/root/repo", "show",
+         f"{LOCKSTEP_REV}:dtdl_tpu/parallel/megatron.py"],
+        capture_output=True, text=True, check=True).stdout
+    with tempfile.NamedTemporaryFile("w", suffix="_megatron_old.py",
+                                     delete=False) as f:
+        f.write(src)
+        path = f.name
+    spec = importlib.util.spec_from_file_location("megatron_lockstep", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass decoration resolves cls.__module__ through sys.modules
+    sys.modules["megatron_lockstep"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def time_step(M, label, iters=6, warmup=2):
+    from dtdl_tpu.runtime.mesh import build_mesh
+
+    mesh = build_mesh((1, 1, 4, 2), M.AXES, devices=jax.devices())
+    cfg = M.MegatronConfig(
+        vocab_size=128, d_model=128, n_heads=4, d_ff=512,
+        n_stages=4, layers_per_stage=2, n_microbatches=4,
+        max_seq=256, dtype=jnp.float32)
+    params = M.place_params(mesh, cfg,
+                            M.init_params(cfg, jax.random.PRNGKey(0)))
+    opt = optax.sgd(0.01)
+    opt_state = M.init_optimizer(cfg, mesh, opt, params)
+    step = M.make_megatron_train_step(cfg, mesh, opt)
+    rng = np.random.default_rng(0)
+    B, S = 8, 256
+    batch = M.shard_lm_batch(mesh, {
+        "tokens": rng.integers(0, 128, (B, S)).astype(np.int32),
+        "targets": rng.integers(0, 128, (B, S)).astype(np.int32),
+        "mask": np.ones((B, S), np.float32),
+    })
+    args = (batch["tokens"], batch["targets"], batch["mask"])
+    for _ in range(warmup):
+        params, opt_state, loss, _ = step(params, opt_state, *args)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss, _ = step(params, opt_state, *args)
+    final = float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(final)
+    return {"schedule": label, "step_ms": round(dt * 1e3, 1),
+            "loss": round(final, 6)}
+
+
+if __name__ == "__main__":
+    old = load_old_megatron()
+    from dtdl_tpu.parallel import megatron as new
+
+    r_old = time_step(old, "lockstep")
+    r_new = time_step(new, "segmented")
+    ratio = r_old["step_ms"] / r_new["step_ms"]
+    print(json.dumps({"lockstep": r_old, "segmented": r_new,
+                      "speedup": round(ratio, 3),
+                      "loss_equal": r_old["loss"] == r_new["loss"],
+                      "predicted_speedup": round(30 / 21, 3)}))
