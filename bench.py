@@ -445,18 +445,20 @@ def scaling_section(records) -> dict:
         elif r["model"] == "resnet50" and r["batch_size"] == 256:
             key, model, ex = ("resnet50_bs256", resnet50(),
                               jnp.zeros((1, 224, 224, 3)))
-        elif r["model"] == "lm" and r.get("size") == "base":
-            key, model, ex = ("lm_base_seq4096",
-                              transformer_lm("base", max_seq=r["seq"]),
+        elif r["model"] == "lm" and r.get("size") in ("base", "large"):
+            key, model, ex = (f"lm_{r['size']}_seq{r['seq']}",
+                              transformer_lm(r["size"], max_seq=r["seq"]),
                               jnp.zeros((1, r["seq"]), jnp.int32))
         if key:
             gb = _grad_bytes(model, ex)
             out[key] = {"grad_mbytes": round(gb / 1e6, 1),
                         **modeled_scaling(r["step_time_ms"] / 1e3, gb)}
-            if key == "lm_base_seq4096":
+            if key.startswith("lm_"):
                 # the 4D engine's strong-scaling model, anchored on the
-                # same measured step (SCALING.md "The 4D model")
-                out["megatron_4d"] = modeled_scaling_4d(
+                # same measured step (SCALING.md "The 4D model"); 'large'
+                # shows the shape effect — bigger d_model amortizes the
+                # tp activation psums over 4x the MXU work
+                out[f"megatron_4d_{r['size']}"] = modeled_scaling_4d(
                     r["step_time_ms"] / 1e3, gb,
                     d_model=model.d_model, n_layers=model.n_layers,
                     batch=r["batch_size"], seq=r["seq"])

@@ -148,13 +148,21 @@ def test_train_lm_example(tmp_path):
 
 @pytest.mark.slow
 def test_train_lm_4d_example(tmp_path):
-    """Full dp/sp/pp/tp+ep step over a 1,2,2,1 mesh (4 fake devices)."""
+    """Full dp/sp/pp/tp+ep step over a 1,2,2,1 mesh (4 fake devices),
+    with periodic held-out validation on the same mesh (the 4D eval
+    step: reference evaluate-parity, tensorflow2/mnist_single.py:88-92)."""
     out = run_example(
         "train_lm_4d.py", "--steps", "3", "--batch-size", "8",
-        "--seq-len", "64", "--n-experts", "2", "--mesh", "1,2,2,1")
+        "--seq-len", "64", "--n-experts", "2", "--mesh", "1,2,2,1",
+        "--eval-interval", "2", "--eval-batches", "1")
     m = re.search(r"final loss ([\d.]+)", out)
     assert m, out
     assert float(m.group(1)) < 10.0
+    vals = re.findall(r"val_loss: ([\d.]+)", out)
+    # step 2 (interval) and step 3 (end-of-run, off-interval)
+    assert len(vals) == 2, out
+    assert all(0.0 < float(v) < 10.0 for v in vals)
+    assert "val_accuracy" in out
 
 
 @pytest.mark.slow

@@ -61,11 +61,18 @@ def test_modeled_scaling_4d_anchor_and_structure():
 def test_scaling_section_emits_headline_rows_and_sanity():
     rows = [{"model": "pyramidnet", "batch_size": 256, "step_time_ms": 63.8},
             {"model": "lm", "size": "base", "seq": 4096, "batch_size": 8,
-             "step_time_ms": 126.7}]
+             "step_time_ms": 126.7},
+            {"model": "lm", "size": "large", "seq": 4096, "batch_size": 4,
+             "step_time_ms": 261.3}]
     out = bench.scaling_section(rows)
     assert set(out) == {"pyramidnet_bs256", "lm_base_seq4096",
-                        "megatron_4d", "reference_4gpu_sanity"}
-    assert out["megatron_4d"]["1,1,1,1"]["efficiency"] == 1.0
+                        "lm_large_seq4096", "megatron_4d_base",
+                        "megatron_4d_large", "reference_4gpu_sanity"}
+    assert out["megatron_4d_base"]["1,1,1,1"]["efficiency"] == 1.0
+    # the shape effect the table argues: large's bigger d_model amortizes
+    # the tp psums over more MXU work -> better tp-only efficiency
+    assert (out["megatron_4d_large"]["1,1,1,8"]["efficiency"]
+            > out["megatron_4d_base"]["1,1,1,8"]["efficiency"])
     assert out["pyramidnet_bs256"]["grad_mbytes"] == 97.0   # params only, no BN stats
     # the model reproduces the reference's 4-GPU point with a physically
     # plausible effective bandwidth (unoverlapped PCIe-era allreduce)
