@@ -458,7 +458,7 @@ def scaling_section(records) -> dict:
                 # same measured step (SCALING.md "The 4D model"); 'large'
                 # shows the shape effect — bigger d_model amortizes the
                 # tp activation psums over 4x the MXU work
-                out[f"megatron_4d_{r['size']}"] = modeled_scaling_4d(
+                out[f"megatron_4d_{key[3:]}"] = modeled_scaling_4d(
                     r["step_time_ms"] / 1e3, gb,
                     d_model=model.d_model, n_layers=model.n_layers,
                     batch=r["batch_size"], seq=r["seq"])

@@ -200,16 +200,19 @@ class MoE(nn.Module):
     useless at scale.
 
     ``dispatch='routed'`` (the GSPMD scale path): GShard-style
-    capacity-factor top-k.  Each batch row is a routing group with
-    ``C = ceil(cf · S · k / E)`` slots per expert; assignments fill
-    choice-major (every first choice before any second choice, matching
-    the megatron engine's routed dispatch, parallel/megatron.py:286-392),
-    tokens past capacity are dropped (their residual passes through).
-    Dispatch/combine are one-hot einsums to a fixed [E, B, C, D] expert
-    buffer — static shapes throughout, so under the 'tp'/'tp_fsdp'
-    logical rules (parallel/tensor.py) the expert dim shards on 'model'
-    and XLA's partitioner inserts the token all-to-all; expert FFN FLOPs
-    drop to O(cf · k · tokens · D · F), E-independent.
+    capacity-factor top-k.  Tokens are split into routing groups of up
+    to ``group_size`` consecutive tokens (1024 default — the measured
+    knee; ragged tails padded and masked out of routing), each group
+    getting ``C = ceil(cf · g · k / E)`` slots per expert; assignments
+    fill choice-major (every first choice before any second choice,
+    matching the megatron engine's routed dispatch,
+    parallel/megatron.py:286-392), tokens past capacity are dropped
+    (their residual passes through).  Dispatch/combine are one-hot
+    einsums to a fixed [E, n_groups, C, D] expert buffer — static shapes
+    throughout, so under the 'ep' logical rules (parallel/tensor.py) the
+    expert dim shards on 'model' and XLA's partitioner inserts the token
+    all-to-all; expert FFN FLOPs drop to O(cf · k · tokens · D · F),
+    E-independent.
 
     Both modes share identical parameters (router/wi/wg/wo), so a dense
     checkpoint loads into a routed model and, with ``capacity_factor >=
@@ -226,6 +229,14 @@ class MoE(nn.Module):
     dispatch: str = "dense"       # 'dense' | 'routed'
     capacity_factor: float = 1.25
     top_k: int = 1
+    # routing-group CAP (tokens): the dispatch/combine one-hot einsums
+    # cost O(tokens · E · C · D) with C = cf·g·k/E, i.e. O(tokens · g)
+    # per token — groups bound g the way GShard does, instead of paying
+    # the whole sequence length.  Groups are g consecutive tokens within
+    # a batch row; a ragged tail is padded and the pad tokens are
+    # excluded from routing (they take no capacity).  0 = the measured
+    # default cap of 1024
+    group_size: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -283,9 +294,34 @@ class MoE(nn.Module):
         return y * gate.astype(self.dtype)
 
     def _routed(self, x, probs, w_in, w_gate, w_out):
-        """Capacity-factor top-k dispatch (see class docstring)."""
+        """Capacity-factor top-k dispatch (see class docstring).
+
+        Tokens are split into routing groups of up to ``group_size``
+        consecutive tokens (GShard-style): capacity is per (batch row,
+        group), so the [*, g, E, C] dispatch tensors stay O(g) per token
+        instead of O(seq) — at seq 4096 / E 8 / cf 1.25 the ungrouped
+        dispatch einsum alone would cost ~2x the expert FFN FLOPs.  A
+        ragged last group is padded; pad tokens are masked out of the
+        routing entirely (no capacity consumed, output sliced away), so
+        any sequence length works — including single-token decode, where
+        g=1 makes capacity a no-drop identity (inference never drops).
+        Measured on the v5e ('base'+E8 forward, bs 8 seq 4096): dense
+        dispatch 54.6 ms, routed ungrouped 45.1 ms, g=1024 **38.2 ms**,
+        g=256 38.8 ms — the 1024 default cap is the measured knee."""
         import math
-        b, s, d_model = x.shape
+        b, s_full, d_model = x.shape
+        g = min(self.group_size or 1024, s_full)
+        pad = -s_full % g
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+            probs = jnp.pad(probs, ((0, 0), (0, pad), (0, 0)))
+        n_groups = b * ((s_full + pad) // g)
+        # [g] validity per position of each row-group, tiled over rows
+        valid = (jnp.arange(s_full + pad) < s_full).astype(jnp.float32)
+        valid = jnp.tile(valid.reshape(-1, g), (b, 1))   # [n_groups, g]
+        x = x.reshape(n_groups, g, d_model)
+        probs = probs.reshape(n_groups, g, self.n_experts)
+        b, s = n_groups, g
         E, k = self.n_experts, self.top_k
         C = min(s, int(math.ceil(self.capacity_factor * s * k / E)))
 
@@ -300,7 +336,8 @@ class MoE(nn.Module):
         combine = jnp.zeros((b, s, E, C), jnp.float32)
         taken = jnp.zeros((b, 1, E), jnp.float32)        # slots used so far
         for j in range(k):                               # choice-major fill
-            m = jax.nn.one_hot(idx[:, :, j], E, dtype=jnp.float32)
+            m = jax.nn.one_hot(idx[:, :, j], E,
+                               dtype=jnp.float32) * valid[..., None]
             pos = jnp.cumsum(m, axis=1) - m + taken      # [b, s, E]
             keep = m * (pos < C)
             slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
@@ -325,8 +362,9 @@ class MoE(nn.Module):
         y = jnp.einsum("ebcf,efd->ebcd", h, w_out)
         y = nn.with_logical_constraint(
             y, ("expert", "batch", None, "embed"))
-        return jnp.einsum("ebcd,bsec->bsd", y,
-                          combine.astype(self.dtype))
+        out = jnp.einsum("ebcd,bsec->bsd", y,
+                         combine.astype(self.dtype))
+        return out.reshape(-1, s_full + pad, d_model)[:, :s_full]
 
 
 class Block(nn.Module):
@@ -339,6 +377,7 @@ class Block(nn.Module):
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
     moe_top_k: int = 1
+    moe_group_size: int = 0
 
     @nn.compact
     def __call__(self, x, cos, sin, decode: bool = False):
@@ -351,7 +390,8 @@ class Block(nn.Module):
             x = x + MoE(self.n_experts, self.d_ff, self.dtype,
                         dispatch=self.moe_dispatch,
                         capacity_factor=self.capacity_factor,
-                        top_k=self.moe_top_k, name="moe")(h)
+                        top_k=self.moe_top_k,
+                        group_size=self.moe_group_size, name="moe")(h)
         else:
             x = x + SwiGLU(self.d_ff, self.dtype, name="mlp")(h)
         return x
@@ -368,8 +408,9 @@ class TransformerLM(nn.Module):
     n_experts: int = 0            # 0 = dense SwiGLU MLP
     moe_every: int = 2            # every k-th block is MoE (when n_experts>0)
     moe_dispatch: str = "dense"   # 'dense' oracle | 'routed' capacity top-k
-    capacity_factor: float = 1.25  # routed: slots = ceil(cf * S * k / E)
+    capacity_factor: float = 1.25  # routed: slots = ceil(cf * g * k / E)
     moe_top_k: int = 1            # routed: experts per token
+    moe_group_size: int = 0       # routing group (0 = min(seq, 1024))
     attn_impl: str = "flash"
     remat: bool = False
     dtype: Dtype = jnp.bfloat16
@@ -415,6 +456,7 @@ class TransformerLM(nn.Module):
                 moe_dispatch=self.moe_dispatch,
                 capacity_factor=self.capacity_factor,
                 moe_top_k=self.moe_top_k,
+                moe_group_size=self.moe_group_size,
                 name=f"block_{i}")
             # only pass the flag when set: a kwarg through nn.remat is
             # traced, and Attention branches on it in Python

@@ -66,13 +66,14 @@ def test_scaling_section_emits_headline_rows_and_sanity():
              "step_time_ms": 261.3}]
     out = bench.scaling_section(rows)
     assert set(out) == {"pyramidnet_bs256", "lm_base_seq4096",
-                        "lm_large_seq4096", "megatron_4d_base",
-                        "megatron_4d_large", "reference_4gpu_sanity"}
-    assert out["megatron_4d_base"]["1,1,1,1"]["efficiency"] == 1.0
+                        "lm_large_seq4096", "megatron_4d_base_seq4096",
+                        "megatron_4d_large_seq4096",
+                        "reference_4gpu_sanity"}
+    assert out["megatron_4d_base_seq4096"]["1,1,1,1"]["efficiency"] == 1.0
     # the shape effect the table argues: large's bigger d_model amortizes
     # the tp psums over more MXU work -> better tp-only efficiency
-    assert (out["megatron_4d_large"]["1,1,1,8"]["efficiency"]
-            > out["megatron_4d_base"]["1,1,1,8"]["efficiency"])
+    assert (out["megatron_4d_large_seq4096"]["1,1,1,8"]["efficiency"]
+            > out["megatron_4d_base_seq4096"]["1,1,1,8"]["efficiency"])
     assert out["pyramidnet_bs256"]["grad_mbytes"] == 97.0   # params only, no BN stats
     # the model reproduces the reference's 4-GPU point with a physically
     # plausible effective bandwidth (unoverlapped PCIe-era allreduce)

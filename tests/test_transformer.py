@@ -62,6 +62,30 @@ def test_routed_moe_matches_dense_when_nothing_drops():
     out_r, aux_r = routed.apply(vars_, toks, mutable=["aux_loss"])
     np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_r),
                                atol=2e-5, rtol=1e-5)
+    # routing groups change only WHERE capacity applies, not the math:
+    # with nothing droppable, grouped dispatch is the same function —
+    # including a ragged tail (g=12 on s=32 pads the last group; pad
+    # tokens must take no capacity and leave no trace in the output)
+    for g in (8, 12):
+        grouped = transformer_lm("tiny", n_experts=4, moe_every=1,
+                                 attn_impl="dense", dtype=jnp.float32,
+                                 moe_dispatch="routed",
+                                 capacity_factor=4.0, moe_group_size=g)
+        out_g = grouped.apply(vars_, toks)
+        np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_g),
+                                   atol=2e-5, rtol=1e-5, err_msg=f"g={g}")
+
+    # decode works on grouped routed models: single-token steps get
+    # g=1 (capacity becomes a no-drop identity — inference never drops)
+    from dtdl_tpu.models import generate
+    routed_big_g = transformer_lm("tiny", n_experts=4, moe_every=1,
+                                  attn_impl="dense", dtype=jnp.float32,
+                                  moe_dispatch="routed",
+                                  capacity_factor=4.0,
+                                  moe_group_size=1024)
+    out_tok = generate(routed_big_g, vars_["params"], toks[:, :5], 4)
+    ref_tok = generate(dense, vars_["params"], toks[:, :5], 4)
+    np.testing.assert_array_equal(np.asarray(out_tok), np.asarray(ref_tok))
     # identical routing statistics -> identical balance aux
     for a, b in zip(jax.tree.leaves(aux_d["aux_loss"]),
                     jax.tree.leaves(aux_r["aux_loss"])):
