@@ -168,7 +168,16 @@ def lm_analytic_flops(cfg, batch: int, seq: int) -> float:
     attn = 2 * 2 * batch * cfg.n_heads * t * t * cfg.head_dim * 0.5
     mlp = 3 * 2 * batch * t * cfg.d_model * cfg.d_ff
     head = 2 * batch * t * cfg.d_model * cfg.vocab_size
-    fwd = cfg.n_layers * (qkvo + attn + mlp) + head
+    n_moe = 0
+    if getattr(cfg, "n_experts", 0) and hasattr(cfg, "moe_every"):
+        # MoE layers (every moe_every-th, TransformerLM's rule) count
+        # ACTIVATED expert compute (top_k x the dense MLP — the standard
+        # MoE model-FLOPs convention); the router, dispatch/combine
+        # einsums, and capacity over-provisioning (cf > 1 executes more)
+        # are deliberately not credited
+        n_moe = cfg.n_layers // cfg.moe_every
+    fwd = (cfg.n_layers * (qkvo + attn) + (cfg.n_layers - n_moe) * mlp
+           + n_moe * getattr(cfg, "moe_top_k", 1) * mlp + head)
     return 3.0 * fwd
 
 
@@ -495,7 +504,7 @@ _SWEEP = {
     "lm": (8,),
 }
 
-_LM_SIZES = ("small", "base", "large")
+_LM_SIZES = ("small", "base", "large", "base-moe8")
 # per-size batch override for the sweep (explicit --batch-size wins):
 # 'large' peaks at bs 4 — see bench_lm's docstring
 _LM_BS = {"large": 4}
