@@ -57,6 +57,9 @@ def main():
          help="routed: per-expert slots = ceil(cf * seq * k / n_experts)")
     flag(parser, "--moe-top-k", type=int, default=1,
          help="routed: experts per token (1 = Switch, 2 = GShard top-2)")
+    flag(parser, "--moe-group-size", type=int, default=0,
+         help="routed: routing-group token cap (0 = 1024, the measured "
+              "knee; capacity applies per group)")
     flag(parser, "--moe-aux-weight", type=float, default=0.01,
          help="Switch load-balance aux loss weight (added to the "
               "training loss; 0 disables)")
@@ -79,7 +82,8 @@ def main():
                            attn_impl=args.attn, n_experts=args.n_experts,
                            moe_dispatch=args.moe_dispatch,
                            capacity_factor=args.capacity_factor,
-                           moe_top_k=args.moe_top_k)
+                           moe_top_k=args.moe_top_k,
+                           moe_group_size=args.moe_group_size)
     if train_tokens.max() >= model.vocab_size:
         raise SystemExit("dataset vocab exceeds model vocab")
 
