@@ -363,6 +363,27 @@ def test_lm_step_trains_with_moe_aux_loss():
     np.testing.assert_allclose(ddp["loss"] - 0.01 * ddp["moe_aux_loss"],
                                off["loss"], rtol=1e-5)
 
+    # routed dispatch under DDP: batch rows shard across replicas but
+    # routing groups live within rows, so the sharded step computes the
+    # identical CE to single-device (aux is per-replica, like dense)
+    routed = transformer_lm("tiny", n_experts=4, moe_every=1,
+                            dtype=jnp.float32, moe_dispatch="routed",
+                            capacity_factor=4.0)
+
+    def run_routed(strategy):
+        state = strategy.replicate(init_state(
+            routed, jax.random.PRNGKey(0), jnp.zeros((1, 65), jnp.int32),
+            optax.sgd(0.1)))
+        step = make_lm_train_step(strategy, moe_aux_weight=0.01)
+        state, m = step(state, strategy.shard_batch({"tokens": toks}))
+        return {k: float(v) for k, v in m.items()}
+
+    r_single = run_routed(SingleDevice())
+    r_ddp = run_routed(DataParallel())
+    np.testing.assert_allclose(
+        r_ddp["loss"] - 0.01 * r_ddp["moe_aux_loss"],
+        r_single["loss"] - 0.01 * r_single["moe_aux_loss"], rtol=1e-5)
+
     # a dense (no-experts) model emits no aux metric and no aux term
     plain = transformer_lm("tiny", dtype=jnp.float32)
     state = init_state(plain, jax.random.PRNGKey(0),
