@@ -83,6 +83,7 @@ if __name__ == "__main__":
         # memory bound in action
         ("base", 4, 8192, 0, False),
         ("base", 2, 16384, 4096, False),
+        ("base", 1, 32768, 4096, False),
         ("large", 2, 8192, 0, False),
     ]
     if len(sys.argv) > 1 and sys.argv[1] == "--size":
