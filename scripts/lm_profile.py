@@ -21,10 +21,14 @@ SIZE = sys.argv[1] if len(sys.argv) > 1 else "base"
 BS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
 SEQ = int(sys.argv[3]) if len(sys.argv) > 3 else 4096
 CHUNK = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+# 5th arg: override the preset's remat (e.g. 'large 4 4096 0 0' = the
+# bench headline config, which turns the preset's remat off)
+REMAT = ({} if len(sys.argv) <= 5
+         else {"remat": bool(int(sys.argv[5]))})
 TRACE_DIR = "/tmp/lm_trace"
 
 strategy = choose_strategy("auto")
-model = transformer_lm(SIZE, max_seq=SEQ)
+model = transformer_lm(SIZE, max_seq=SEQ, **REMAT)
 state = strategy.replicate(init_state(
     model, jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32),
     optax.adamw(3e-4)))
