@@ -16,5 +16,5 @@ from dtdl_tpu.parallel.megatron import (  # noqa: F401
 )
 from dtdl_tpu.parallel.tensor import (  # noqa: F401
     RULE_PRESETS, init_sharded_lm, logical_shardings,
-    make_sharded_lm_train_step,
+    make_sharded_lm_eval_step, make_sharded_lm_train_step,
 )

@@ -135,16 +135,9 @@ def make_sharded_lm_train_step(model, mesh: Mesh, tx, shardings,
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))
 
     def step(params, opt_state, tokens):
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-
         def loss_fn(p):
-            with nn.logical_axis_rules(rules):
-                logits = model.apply(
-                    {"params": p}, inputs).astype(jnp.float32)
-            lse = jax.nn.logsumexp(logits, -1)
-            true = jnp.take_along_axis(
-                logits, targets[..., None].astype(jnp.int32), -1)[..., 0]
-            return jnp.mean(lse - true)
+            loss, _ = _lm_shift_loss(model, rules, p, tokens)
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         import optax
@@ -156,3 +149,44 @@ def make_sharded_lm_train_step(model, mesh: Mesh, tx, shardings,
         in_shardings=(param_sh, opt_sh, batch_sh),
         out_shardings=(param_sh, opt_sh, NamedSharding(mesh, P())),
         donate_argnums=(0, 1))
+
+
+def _lm_shift_loss(model, rules, params, tokens):
+    """Shared next-token objective of the GSPMD train AND eval steps
+    (one definition, so a numerics change cannot drift between them):
+    shift, forward under the logical-rules context, mean CE — returns
+    ``(loss, accuracy)``."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:].astype(jnp.int32)
+    with nn.logical_axis_rules(rules):
+        logits = model.apply({"params": params}, inputs).astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, -1)
+    true = jnp.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    acc = jnp.mean((jnp.argmax(logits, -1) == targets)
+                   .astype(jnp.float32))
+    return jnp.mean(lse - true), acc
+
+
+def make_sharded_lm_eval_step(model, mesh: Mesh, shardings, rules="tp"):
+    """Forward-only validation for the GSPMD face: mean next-token loss
+    and token accuracy, no optimizer, params NOT donated (they are
+    reused for training).  Same rule-context contract as
+    :func:`make_sharded_lm_train_step`; parity with the strategy
+    engines' ``make_eval_step`` and the 4D ``make_megatron_eval_step``
+    (reference evaluate-parity: tensorflow2/mnist_single.py:88-92).
+    """
+    if isinstance(rules, str):
+        rules = RULE_PRESETS[rules]
+    rules = list(rules)
+    param_sh, _ = shardings
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+
+    def evaluate(params, tokens):
+        loss, acc = _lm_shift_loss(model, rules, params, tokens)
+        return {"loss": loss, "accuracy": acc,
+                "n_tokens": jnp.float32(tokens.shape[0]
+                                        * (tokens.shape[1] - 1))}
+
+    out_sh = {k: NamedSharding(mesh, P())
+              for k in ("loss", "accuracy", "n_tokens")}
+    return jax.jit(evaluate, in_shardings=(param_sh, batch_sh),
+                   out_shardings=out_sh)

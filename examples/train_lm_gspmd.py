@@ -32,6 +32,7 @@ from dtdl_tpu.data import load_dataset
 from dtdl_tpu.metrics import Reporter, StdoutSink
 from dtdl_tpu.models import transformer_lm
 from dtdl_tpu.parallel.tensor import (RULE_PRESETS, init_sharded_lm,
+                                      make_sharded_lm_eval_step,
                                       make_sharded_lm_train_step)
 from dtdl_tpu.runtime.mesh import build_mesh
 from dtdl_tpu.utils import seed_everything
@@ -61,6 +62,8 @@ def main():
          help="data,model sizes, e.g. 2,4 (default: all devices on "
               "'data' for fsdp/replicated, split 2-ways onto 'model' "
               "otherwise)")
+    flag(parser, "--eval-batches", type=int, default=2,
+         help="held-out validation batches after training")
     args = parser.parse_args()
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")
@@ -94,8 +97,8 @@ def main():
         moe_dispatch=args.moe_dispatch,
         capacity_factor=args.capacity_factor, moe_top_k=args.moe_top_k)
 
-    train_tokens, _ = load_dataset(args.dataset, seq_len=args.seq_len + 1,
-                                   vocab_size=vocab)
+    train_tokens, test_tokens = load_dataset(
+        args.dataset, seq_len=args.seq_len + 1, vocab_size=vocab)
     tx = optax.adamw(args.lr)
     # init with the step's INPUT length: the train step shifts the
     # (seq_len+1)-token batch into seq_len inputs/targets
@@ -118,6 +121,25 @@ def main():
         if i % args.log_interval == 0:
             reporter.report({"step": i, "loss": float(loss),
                              "rules": args.rules, "mesh": str(shape)})
+
+    # held-out validation under the same shardings (reference parity:
+    # every reference script evaluates — SURVEY §5.4/§5.5), token-
+    # weighted over --eval-batches batches like train_lm_4d.py's
+    ev = make_sharded_lm_eval_step(model, mesh, sh, rules=args.rules)
+    loss_sum = acc_sum = tok_sum = 0.0
+    for j in range(args.eval_batches):
+        take = np.arange(j * B, (j + 1) * B) % len(test_tokens)
+        vb = jax.device_put(
+            np.ascontiguousarray(test_tokens[take], np.int32), batch_sh)
+        m = ev(params, vb)
+        n = float(m["n_tokens"])
+        loss_sum += float(m["loss"]) * n
+        acc_sum += float(m["accuracy"]) * n
+        tok_sum += n
+    reporter.report({"step": args.steps,
+                     "val_loss": loss_sum / max(tok_sum, 1.0),
+                     "val_accuracy": acc_sum / max(tok_sum, 1.0),
+                     "val_tokens": tok_sum})
     print(f"final loss {float(loss):.6f} rules={args.rules} "
           f"mesh={shape}", flush=True)
 

@@ -181,6 +181,9 @@ def test_train_lm_gspmd_example(tmp_path):
     # it actually learns: below both the step-0 loss and uniform ln(256)
     assert float(final.group(1)) < float(first.group(1))
     assert float(final.group(1)) < 5.545
+    # held-out validation ran under the same shardings
+    val = re.search(r"val_loss: ([\d.]+)", out)
+    assert val and 0.0 < float(val.group(1)) < 10.0, out
 
 
 @pytest.mark.slow

@@ -176,6 +176,48 @@ def test_routed_moe_trains_sharded_and_matches_replicated(devices):
     np.testing.assert_allclose(got, oracle, rtol=2e-4)
 
 
+def test_sharded_eval_matches_unsharded(devices):
+    """make_sharded_lm_eval_step: loss/accuracy identical to an
+    unsharded evaluation of the same params, on 'tp' and 'ep' rules
+    (routed MoE under ep)."""
+    mesh = build_mesh(shape=(2, 4), axes=("data", "model"),
+                      devices=devices)
+    tx = optax.adamw(1e-3)
+    toks0 = jnp.zeros((1, 32), jnp.int32)
+    batch_host = jnp.asarray(
+        np.random.default_rng(1).integers(0, 256, (8, 33)), jnp.int32)
+
+    for rules, kw in (("tp", {}),
+                      ("ep", dict(n_experts=4, moe_every=1,
+                                  moe_dispatch="routed",
+                                  capacity_factor=4.0))):
+        model = transformer_lm("tiny", attn_impl="dense",
+                               dtype=jnp.float32, **kw)
+        params, _, sh = T.init_sharded_lm(model, mesh, tx, toks0,
+                                          rules=rules)
+        ev = T.make_sharded_lm_eval_step(model, mesh, sh, rules=rules)
+        got = ev(params, jax.device_put(
+            batch_host, NamedSharding(mesh, P("data"))))
+
+        # unsharded oracle on the same values
+        import flax.linen as nn
+        ref_params = nn.unbox(
+            model.init(jax.random.PRNGKey(0), toks0)["params"])
+        inputs, targets = batch_host[:, :-1], batch_host[:, 1:]
+        logits = model.apply({"params": ref_params}, inputs)
+        lse = jax.nn.logsumexp(logits, -1)
+        true = jnp.take_along_axis(
+            logits, targets[..., None].astype(jnp.int32), -1)[..., 0]
+        np.testing.assert_allclose(float(got["loss"]),
+                                   float(jnp.mean(lse - true)),
+                                   rtol=2e-5, err_msg=rules)
+        acc = float(jnp.mean((jnp.argmax(logits, -1) == targets)
+                             .astype(jnp.float32)))
+        np.testing.assert_allclose(float(got["accuracy"]), acc,
+                                   atol=1e-6, err_msg=rules)
+        assert float(got["n_tokens"]) == 8 * 32
+
+
 def test_tp_sharded_decode_token_identical(devices):
     """generate() with tensor-parallel params: pass the 'tp'-sharded
     param tree as-is and jit/GSPMD propagates the shardings through
