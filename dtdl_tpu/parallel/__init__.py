@@ -12,7 +12,7 @@ from dtdl_tpu.parallel.sequence import (  # noqa: F401
 )
 from dtdl_tpu.parallel.megatron import (  # noqa: F401
     MegatronConfig, build_4d_mesh, factor_mesh,
-    make_megatron_eval_step, make_megatron_train_step,
+    make_megatron_eval_step, make_megatron_train_step, to_flax_params,
 )
 from dtdl_tpu.parallel.tensor import (  # noqa: F401
     RULE_PRESETS, init_sharded_lm, logical_shardings,

@@ -1182,6 +1182,63 @@ def shard_lm_batch(mesh: Mesh, batch: dict) -> dict:
             for k, v in batch.items()}
 
 
+def to_flax_params(cfg: MegatronConfig, params: dict) -> dict:
+    """Convert the 4D engine's stacked parameter tree into the flax
+    :class:`~dtdl_tpu.models.transformer.TransformerLM` tree — the
+    serving bridge: train on the megatron engine, restore a snapshot,
+    convert, and decode with ``models.generate`` (single-device,
+    DP-batch-sharded, or tensor-parallel — generate propagates whatever
+    sharding the converted params carry).
+
+    The stacked ``blocks`` leaves are [n_stages, layers_per_stage, ...];
+    execution order is the (interleaved) virtual pipeline's — virtual
+    stage ``u = c*S + st`` runs device st's chunk-c rows — so flax
+    ``block_j`` takes row ``order[j]``.  Attention kernels reshape
+    [D, H*hd] -> [D, H, hd] (flax DenseGeneral layout); both engines
+    share the rope/RMSNorm/SwiGLU ops, so the converted model computes
+    the identical function (pinned by test).  MoE configs map too
+    (router/wi/wg/wo shapes coincide) but require the flax model built
+    with ``moe_every=1`` — the megatron engine puts an MoE in *every*
+    block.  Pass host (or fully-addressable) arrays; use
+    ``jax.device_get`` on a sharded state first.
+    """
+    S, Lc_total, v = cfg.n_stages, cfg.layers_per_stage, cfg.virtual_stages
+    H, hd, D = cfg.n_heads, cfg.head_dim, cfg.d_model
+    if Lc_total % v:
+        # same guard as the engine (_value_and_grad_1f1b): a silent
+        # truncated conversion would fail far away with missing blocks
+        raise ValueError(f"virtual_stages={v} must divide "
+                         f"layers_per_stage={Lc_total}")
+    Lc = Lc_total // v
+    order = [(u % S, (u // S) * Lc + i)
+             for u in range(v * S) for i in range(Lc)]
+    blocks = params["blocks"]
+    out = {"embed": params["embed"],
+           "ln_f": {"scale": params["ln_f"]}}
+    for j, (st, li) in enumerate(order):
+        p = {k: a[st, li] for k, a in blocks.items()}
+        blk = {
+            "ln_attn": {"scale": p["ln_attn"]},
+            "ln_mlp": {"scale": p["ln_mlp"]},
+            "attn": {
+                "q": {"kernel": p["wq"].reshape(D, H, hd)},
+                "k": {"kernel": p["wk"].reshape(D, H, hd)},
+                "v": {"kernel": p["wv"].reshape(D, H, hd)},
+                "out": {"kernel": p["wo"].reshape(H, hd, D)},
+            },
+        }
+        if cfg.n_experts:
+            blk["moe"] = {"router": {"kernel": p["router"]},
+                          "wi": p["wi"], "wg": p["wg"],
+                          "wo": p["wo_mlp"]}
+        else:
+            blk["mlp"] = {"wi": {"kernel": p["wi"]},
+                          "wg": {"kernel": p["wg"]},
+                          "wo": {"kernel": p["wo_mlp"]}}
+        out[f"block_{j}"] = blk
+    return out
+
+
 def place_params(mesh: Mesh, cfg: MegatronConfig, params: dict) -> dict:
     specs = param_specs(cfg)
     return jax.tree.map(

@@ -17,6 +17,7 @@ flags for multi-host.  The mesh is factored automatically unless
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 import optax
 
 from common import bootstrap
@@ -73,6 +74,11 @@ def main():
               "end); reference parity: every reference script evaluates")
     flag(parser, "--eval-batches", type=int, default=2,
          help="validation batches per evaluation")
+    flag(parser, "--generate-tokens", type=int, default=0,
+         help=">0: after training, convert the 4D params to the flax "
+              "tree (megatron.to_flax_params) and greedily decode this "
+              "many tokens — the train-4D/serve-with-generate bridge "
+              "(single-process runs only)")
     args = parser.parse_args()
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")
@@ -211,6 +217,36 @@ def main():
             ckpt.close()
     if not args.eval_interval or args.steps % args.eval_interval:
         run_eval(args.steps)   # end-of-run validation (always)
+
+    if args.generate_tokens:
+        if jax.process_count() > 1:
+            print("skipping --generate-tokens: multi-process params are "
+                  "not fully addressable on one host", flush=True)
+        else:
+            # the serving bridge: 4D stacked params -> flax tree ->
+            # KV-cache decode.  The MoE keeps the TRAINED routing
+            # semantics (routed capacity, same cf/top_k — single-token
+            # steps get one-slot groups, so decode never drops); the
+            # rope table is extended to fit the requested decode length
+            # (rows depend only on position — numerically identical)
+            from dtdl_tpu.models import generate, transformer_lm
+            flax_p = M.to_flax_params(cfg, jax.device_get(params))
+            lm = transformer_lm(
+                "tiny", vocab_size=vocab, d_model=cfg.d_model,
+                n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+                d_ff=cfg.d_ff,
+                max_seq=max(args.seq_len, 8 + args.generate_tokens),
+                attn_impl="dense",
+                n_experts=cfg.n_experts, moe_every=1,
+                moe_dispatch="routed" if cfg.n_experts else "dense",
+                capacity_factor=cfg.capacity_factor,
+                moe_top_k=cfg.moe_top_k, dtype=jnp.float32)
+            prompt = jnp.asarray(train_tokens[:1, :8], jnp.int32)
+            toks_out = generate(lm, flax_p, prompt,
+                                max_new_tokens=args.generate_tokens)
+            print("generated:", np.asarray(toks_out)[0].tolist(),
+                  flush=True)
+
     print(f"final loss {float(loss):.6f} at step {args.steps} "
           f"on mesh {shape}", flush=True)
 
