@@ -154,7 +154,8 @@ def test_train_lm_4d_example(tmp_path):
     out = run_example(
         "train_lm_4d.py", "--steps", "3", "--batch-size", "8",
         "--seq-len", "64", "--n-experts", "2", "--mesh", "1,2,2,1",
-        "--eval-interval", "2", "--eval-batches", "1")
+        "--eval-interval", "2", "--eval-batches", "1",
+        "--generate-tokens", "4")
     m = re.search(r"final loss ([\d.]+)", out)
     assert m, out
     assert float(m.group(1)) < 10.0
@@ -163,6 +164,9 @@ def test_train_lm_4d_example(tmp_path):
     assert len(vals) == 2, out
     assert all(0.0 < float(v) < 10.0 for v in vals)
     assert "val_accuracy" in out
+    # the serving bridge decoded from the 4D-trained params
+    g = re.search(r"generated: \[([\d, ]+)\]", out)
+    assert g and len(g.group(1).split(",")) == 12, out  # 8 prompt + 4 new
 
 
 def test_train_lm_gspmd_example(tmp_path):
