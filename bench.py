@@ -256,6 +256,65 @@ def bench_lm(batch_size: int = 8, seq: int = 4096, size: str = "base",
     return row
 
 
+def bench_host_overhead(steps: int = 192, batch_size: int = 64,
+                        unroll: int = 8, log_interval: int = 24) -> dict:
+    """Host-overhead microbench: sync-every-step vs async-drain vs unrolled.
+
+    Drives the SAME ``train_epoch`` loop three ways over an identical
+    synthetic dataset with a deliberately tiny model (2x64-unit MLP), so
+    the device step is far below the host's per-step work and the loop
+    overhead — per-step ``float()`` syncs vs boundary drains vs one
+    dispatch per ``unroll`` steps — dominates what's measured.  This is the
+    async-dispatch-discipline receipt (SCALING.md): the deltas here are
+    pure host↔device pipeline stalls, the cost every sub-ms-step TPU
+    workload pays when a loop reads a metric on the step it just
+    dispatched.
+    """
+    from dtdl_tpu.data.loader import DataLoader
+    from dtdl_tpu.models import MLP
+    from dtdl_tpu.parallel.strategy import SingleDevice
+    from dtdl_tpu.train import init_state, make_train_step, train_epoch
+
+    strategy = SingleDevice()
+    rng = np.random.default_rng(0)
+    n = steps * batch_size
+    x = rng.normal(size=(n, 64)).astype(np.float32)
+    y = rng.integers(0, 10, n).astype(np.int64)
+    loader = DataLoader({"image": x, "label": y}, batch_size, shuffle=False)
+    tx = optax.sgd(0.01)
+    step = make_train_step(strategy)
+
+    def fresh_state():
+        return strategy.replicate(init_state(
+            MLP(n_units=64), jax.random.PRNGKey(0),
+            jnp.zeros((1, 64)), tx))
+
+    modes = {
+        "sync": dict(sync_every_step=True),
+        "async": dict(),
+        f"unroll{unroll}": dict(unroll=unroll),
+    }
+    row = {"model": "host_overhead", "batch_size": batch_size,
+           "steps": steps, "log_interval": log_interval, "unroll": unroll}
+    rates = {}
+    for name, kw in modes.items():
+        state = fresh_state()
+        # epoch 0 = warmup (compile); epoch 1 = timed
+        state, _ = train_epoch(step, state, loader, strategy,
+                               log_interval=log_interval, **kw)
+        t0 = time.perf_counter()
+        state, means = train_epoch(step, state, loader, strategy,
+                                   log_interval=log_interval, **kw)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(means["loss"])
+        rates[name] = steps / dt
+        row[f"{name}_steps_per_sec"] = round(steps / dt, 1)
+    row["async_speedup_vs_sync"] = round(rates["async"] / rates["sync"], 3)
+    row[f"unroll{unroll}_speedup_vs_sync"] = round(
+        rates[f"unroll{unroll}"] / rates["sync"], 3)
+    return row
+
+
 # ---------------------------------------------------------------------------
 # modeled multi-chip scaling (SCALING.md)
 #
@@ -528,6 +587,9 @@ def main(argv=None) -> dict:
     p.add_argument("--lm-size", default="all",
                    choices=["all"] + list(_LM_SIZES),
                    help="restrict the LM rows to one size")
+    p.add_argument("--skip-host-overhead", action="store_true",
+                   help="skip the sync/async/unrolled host-overhead "
+                        "microbench row")
     a = p.parse_args(argv)
 
     if a.quick:
@@ -576,6 +638,21 @@ def main(argv=None) -> dict:
                     row["size"] = size
             records.append(row)
             print("  " + json.dumps(row), file=sys.stderr, flush=True)
+
+    host_row = None
+    if not a.skip_host_overhead:
+        # host-overhead receipt: sync-every-step vs async-drain vs unrolled
+        # dispatch through the SAME train_epoch loop (tiny model, so the
+        # loop's host↔device stalls dominate) — see SCALING.md
+        try:
+            host_row = bench_host_overhead(
+                steps=max(48, a.sample_budget // 64) if a.sample_budget
+                else 192)
+        except Exception as e:   # the microbench must never sink the bench
+            host_row = {"model": "host_overhead",
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(host_row)
+        print("  " + json.dumps(host_row), file=sys.stderr, flush=True)
 
     ok = [r for r in records if "samples_per_sec" in r]
     # headline = the best-MFU row of the reference-parity model (pyramidnet),
@@ -637,6 +714,9 @@ def main(argv=None) -> dict:
             lm_mfu_best = max(with_mfu, key=lambda r: r["mfu"])
             summary["lm_mfu"] = lm_mfu_best["mfu"]
             summary["lm_mfu_size"] = lm_mfu_best.get("size")
+    if host_row and "async_speedup_vs_sync" in host_row:
+        summary["host_overhead_async_speedup"] = \
+            host_row["async_speedup_vs_sync"]
 
     full = dict(summary)
     full["records"] = records

@@ -1,0 +1,80 @@
+"""Compatibility shims for older jax releases.
+
+The framework is written against the current public API — ``jax.shard_map``,
+``jax.typeof`` with varying-manual-axes (vma) types, ``lax.pcast`` — but some
+images ship a jax that predates them (0.4.x).  :func:`install` patches the
+closest equivalents onto the jax namespace once, at package import, so every
+call site (framework, tests, examples) keeps the one forward-compatible
+spelling instead of forking on the jax version:
+
+* ``jax.shard_map`` → ``jax.experimental.shard_map.shard_map`` with
+  ``check_rep=False``.  The vma type system *replaced* check_rep; this code
+  manages replication explicitly (``pcast`` to varying, collective demotions
+  before ``P()`` out_specs), which the legacy static checker cannot always
+  re-prove — and with identity ``pcast`` (below) it must not try.
+* ``lax.pcast`` → identity.  pcast is a *type* cast between vma sets; it
+  never moves data, so on a jax without vma types there is nothing to do.
+* ``jax.typeof`` → abstract-value lookup whose ``.vma`` is always the empty
+  frozenset — the correct answer on a jax whose avals carry no vma.
+
+Runtime semantics are unchanged: pcast/vma only affect type checking in new
+jax, and the values this code marks replicated genuinely are replicated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.lax
+
+
+class _AvalView:
+    """Proxy of an abstract value that answers ``.vma`` on legacy jax."""
+
+    __slots__ = ("_aval",)
+    vma: frozenset = frozenset()
+
+    def __init__(self, aval):
+        object.__setattr__(self, "_aval", aval)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_aval"), name)
+
+
+def install() -> None:
+    """Idempotently install the shims (no-op on current jax)."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, **kwargs):
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(f, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # legacy jax keeps mapped-axis sizes in the trace-time axis
+            # env; axis_frame returns the static size directly
+            import numpy as np
+            if isinstance(axis_name, (tuple, list)):
+                return int(np.prod([int(jax.core.axis_frame(a))
+                                    for a in axis_name]))
+            return int(jax.core.axis_frame(axis_name))
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axis_name, *, to="varying"):
+            del axis_name, to
+            return x
+
+        jax.lax.pcast = pcast
+
+    if not hasattr(jax, "typeof"):
+        def typeof(x):
+            return _AvalView(jax.core.get_aval(x))
+
+        jax.typeof = typeof

@@ -122,6 +122,17 @@ class Checkpointer:
         self.keep = keep
         self._ocp = None   # lazy, persistent AsyncCheckpointer
         self._last_saved_step = None   # protected from gc until superseded
+        # rollback detection: _supersede (deleting entries ABOVE a save)
+        # only makes sense when this run actually restored from THIS
+        # directory's timeline and is rewriting it.  A fresh Checkpointer
+        # pointed at an existing directory that saves low ids (step
+        # counters start at 0) must NOT delete the previous run's
+        # higher-step snapshots — and a warm start from an *external*
+        # checkpoint path is not a rollback of this directory either.
+        # Tracked per checkpoint shape: restoring a full-state snapshot
+        # says nothing about the epoch-weights timeline and vice versa.
+        self._restored_snapshot = False
+        self._restored_weights = False
         if is_leader():
             os.makedirs(directory, exist_ok=True)
         barrier("ckpt_mkdir")
@@ -168,9 +179,11 @@ class Checkpointer:
         save_weights(path, params)
         # same rollback semantics as full snapshots: an epoch saved below
         # existing ones supersedes the abandoned timeline's later epochs,
-        # so latest_weights() never restores a stale future
-        self._supersede(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack",
-                        epoch)
+        # so latest_weights() never restores a stale future — but only when
+        # this run restored epoch weights first (see _supersede)
+        if self._restored_weights:
+            self._supersede(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack",
+                            epoch)
         self._gc(self._WEIGHT_RE, "weights_epoch_{:04d}.msgpack",
                  protect=epoch)
         return path
@@ -183,7 +196,9 @@ class Checkpointer:
         epoch = max(epochs)
         path = os.path.join(self.directory,
                             f"weights_epoch_{epoch:04d}.msgpack")
-        return load_weights(path, like), epoch
+        restored = load_weights(path, like)
+        self._restored_weights = True
+        return restored, epoch
 
     # -- shape 3: full trainer-state snapshot --------------------------------
 
@@ -201,15 +216,21 @@ class Checkpointer:
             os.path.join(self.directory, f"snapshot_{step}"))
         self._checkpointer.save(path, state, force=True)
         self._last_saved_step = step
-        # Saving a step BELOW existing snapshot ids means training rolled
-        # back (restored an older snapshot) and the higher-step snapshots
-        # belong to the abandoned timeline.  They must not survive: they
-        # would win restore(step=None)/latest_step() after a crash, silently
-        # resuming from the pre-rollback timeline, and they'd permanently
-        # occupy `keep` slots so each new-timeline save left only the
-        # just-saved snapshot alive.  restore() waits for in-flight writes
-        # first, so every stale future is durable and visible here.
-        self._supersede(self._SNAP_RE, "snapshot_{}", step)
+        # Saving a step BELOW existing snapshot ids AFTER this run restored
+        # an older snapshot means training rolled back, and the higher-step
+        # snapshots belong to the abandoned timeline.  They must not
+        # survive: they would win restore(step=None)/latest_step() after a
+        # crash, silently resuming from the pre-rollback timeline, and
+        # they'd permanently occupy `keep` slots so each new-timeline save
+        # left only the just-saved snapshot alive.  restore() waits for
+        # in-flight writes first, so every stale future is durable and
+        # visible here.  Without a prior restore there is no rollback —
+        # a fresh run pointed at an existing directory starts its step
+        # counter at 0, and deleting the previous run's higher snapshots
+        # would be data loss, so _supersede is gated on having restored a
+        # snapshot from this directory.
+        if self._restored_snapshot:
+            self._supersede(self._SNAP_RE, "snapshot_{}", step)
         # The async save is only *staged* here: the snapshot dir still has
         # its orbax tmp name and _list can't see it.  Trimming over the
         # DURABLE list only (never counting the in-flight step as present)
@@ -237,6 +258,7 @@ class Checkpointer:
             os.path.join(self.directory, f"snapshot_{step}"))
         restored = self._checkpointer.restore(path, like)
         _validate_shapes(restored, like, path)
+        self._restored_snapshot = True
         return restored, step
 
     def latest_step(self) -> int | None:
@@ -248,8 +270,15 @@ class Checkpointer:
     def restore_path(self, like, path: str):
         """Restore from an explicit snapshot path (--resume <path>)."""
         self.wait_until_finished()
-        restored = self._checkpointer.restore(os.path.abspath(path), like)
+        abspath = os.path.abspath(path.rstrip("/"))
+        restored = self._checkpointer.restore(abspath, like)
         _validate_shapes(restored, like, path)
+        # a rollback only rewrites THIS directory's timeline: restoring a
+        # snapshot that lives elsewhere (warm start from another run) must
+        # not arm _supersede against this directory's snapshots
+        if (os.path.dirname(abspath) == os.path.abspath(self.directory)
+                and self._SNAP_RE.search(os.path.basename(abspath))):
+            self._restored_snapshot = True
         return restored
 
     # -- shape 1: final weights ----------------------------------------------
@@ -287,7 +316,13 @@ class Checkpointer:
         are stale futures from a timeline abandoned by a rollback restore.
         Runs regardless of ``keep`` (this is a correctness rule for
         restore-latest, not retention policy), leader-gated like all
-        deletions."""
+        deletions.  Callers gate on the per-shape restored flags
+        (``_restored_snapshot`` / ``_restored_weights``): only a run that
+        actually restored this shape from THIS directory is rewriting its
+        timeline; a fresh run saving low ids into an existing directory —
+        or one warm-started from an external checkpoint path — is not a
+        rollback, and deleting the directory's higher-id entries would
+        destroy the previous run's data."""
         if not is_leader():
             return
         for old in self._list(regex):

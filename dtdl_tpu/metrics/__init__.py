@@ -1,3 +1,4 @@
+from dtdl_tpu.metrics.device import MetricsQueue  # noqa: F401
 from dtdl_tpu.metrics.report import (  # noqa: F401
     Reporter, Accumulator, StdoutSink, JsonlSink, TensorBoardSink,
 )

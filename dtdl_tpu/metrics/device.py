@@ -1,0 +1,111 @@
+"""Async device-metrics pipeline: bounded in-flight queue, drain at boundaries.
+
+JAX dispatch is asynchronous on purpose: ``train_step(state, batch)`` returns
+the moment the XLA program is *enqueued*, so the host can dispatch step N+1
+while the device still executes step N.  Every ``float(metrics[...])`` (or
+``block_until_ready``) in the step loop forfeits that: it is a host↔device
+round-trip that stalls the dispatch pipeline once per step — on TPU with
+sub-ms steps the round-trip dominates the step itself (the reference's
+baseline is about keeping accelerators busy; a per-step sync is the exact
+opposite).  See SCALING.md "Async dispatch discipline".
+
+:class:`MetricsQueue` is the discipline factored out: training loops push the
+**raw device-array metric pytree** every step and never convert it inline.
+Conversion to Python floats happens only
+
+* when an entry is **popped by backpressure** — the queue keeps at most
+  ``lag`` entries in flight, so popping converts a metric from ``lag`` steps
+  ago, which the device has long finished (the ``float()`` returns without
+  blocking in wall-clock terms), and the host can never enqueue unbounded
+  work ahead of the device; or
+* at an explicit :meth:`drain` — the log/epoch boundary, where the loop
+  *wants* one honest sync.
+
+Because every step's metrics are converted with the same ``float()`` in the
+same order as the synchronous loop, drained values are **bitwise identical**
+to sync-every-step metrics (pinned by tests/test_async_metrics.py) — this
+changes *when* the host blocks, never *what* it reads.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+import jax
+
+DEFAULT_LAG = 8
+
+
+def to_host(metrics) -> dict:
+    """Convert one metric pytree's leaves to Python floats (blocking)."""
+    return jax.tree.map(float, metrics)
+
+
+def _split_stacked(metrics, count: int) -> list:
+    """Split a stacked metric pytree (leading dim ``count``, e.g. the
+    ``lax.scan`` output of an unrolled bundle) into per-step float dicts.
+
+    One ``device_get`` moves the whole stack; the per-step values are then
+    host-side numpy scalars whose ``float()`` is bitwise what the per-step
+    loop would have read (same f32 value widened to double).
+    """
+    host = jax.device_get(metrics)
+    return [jax.tree.map(lambda a: float(a[j]), host) for j in range(count)]
+
+
+class MetricsQueue:
+    """Bounded in-flight queue of device metric pytrees.
+
+    ``lag`` is the backpressure bound: :meth:`push` converts (oldest first)
+    whatever exceeds it.  ``lag >= log_interval`` means no conversion ever
+    happens between log boundaries — the loop's only syncs are its
+    :meth:`drain` calls.
+
+    Entries pushed with ``count=k`` hold *stacked* metrics for ``k`` steps
+    (the ``unroll`` bundling path); they convert into ``k`` per-step dicts
+    and count as ``k`` toward the in-flight bound.
+    """
+
+    def __init__(self, lag: int = DEFAULT_LAG):
+        if lag < 0:
+            raise ValueError(f"lag must be >= 0, got {lag}")
+        self.lag = lag
+        self._buf: deque[tuple[Any, int]] = deque()
+        self._in_flight = 0
+
+    def __len__(self) -> int:
+        """Steps currently buffered (stacked entries count their width)."""
+        return self._in_flight
+
+    def _pop(self) -> list:
+        metrics, count = self._buf.popleft()
+        self._in_flight -= count
+        if count == 1:
+            return [to_host(metrics)]
+        return _split_stacked(metrics, count)
+
+    def push(self, metrics, count: int = 1) -> list:
+        """Enqueue one step's (or one ``count``-step bundle's) metrics.
+
+        Returns the per-step float dicts popped by backpressure — possibly
+        empty, in step order.  ``lag=0`` degenerates to sync-every-step.
+        """
+        self._buf.append((metrics, count))
+        self._in_flight += count
+        out: list = []
+        while self._in_flight > self.lag:
+            out.extend(self._pop())
+        return out
+
+    def drain(self) -> list:
+        """Convert and return everything still in flight, in step order.
+
+        This is the boundary sync: the newest entry was just dispatched, so
+        this blocks until the device catches up — call it once per
+        log_interval / epoch, not per step.
+        """
+        out: list = []
+        while self._buf:
+            out.extend(self._pop())
+        return out

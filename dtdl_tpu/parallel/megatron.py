@@ -1113,7 +1113,26 @@ def make_megatron_eval_step(cfg: MegatronConfig, mesh: Mesh):
         in_specs=(specs, batch_spec, batch_spec, batch_spec),
         out_specs={"loss": P(), "accuracy": P(), "n_tokens": P()},
     )
-    return jax.jit(mapped)   # no donation: params are reused for training
+    jitted = jax.jit(mapped)   # no donation: params are reused for training
+
+    def eval_step(params, tokens, targets, mask):
+        # validate the microbatch split HERE: inside shard_map tracing the
+        # same mistake surfaces as an opaque reshape error deep in the
+        # pipeline scan, far from the caller's batch-size choice
+        n_data = mesh.shape[DATA]
+        b_glob = tokens.shape[0]
+        b_loc = b_glob // n_data
+        if b_glob % n_data or b_loc % cfg.n_microbatches:
+            raise ValueError(
+                f"eval batch size {b_glob} is not splittable: the local "
+                f"batch b_loc = {b_glob} / {n_data} ('data' mesh axis) = "
+                f"{b_loc} must satisfy b_loc % n_microbatches == 0 "
+                f"(n_microbatches={cfg.n_microbatches}); use a global "
+                f"batch that is a multiple of "
+                f"{n_data * cfg.n_microbatches}")
+        return jitted(params, tokens, targets, mask)
+
+    return eval_step
 
 
 def init_optimizer(cfg: MegatronConfig, mesh: Mesh, optimizer, params):
@@ -1237,6 +1256,41 @@ def to_flax_params(cfg: MegatronConfig, params: dict) -> dict:
                           "wo": {"kernel": p["wo_mlp"]}}
         out[f"block_{j}"] = blk
     return out
+
+
+def to_flax_model(cfg: MegatronConfig, **overrides):
+    """Flax :class:`~dtdl_tpu.models.transformer.TransformerLM` matching
+    ``cfg`` — the model half of the serving bridge (:func:`to_flax_params`
+    is the weights half).
+
+    This is THE single place that maps MegatronConfig fields onto the flax
+    model, so a new config field (say a future ``moe_group_size``) gets
+    wired here once instead of silently drifting in every caller that
+    hand-builds the serving model.  Bridge-mandated settings: ``moe_every=1``
+    (the 4D engine puts an MoE in *every* block), the config's OWN
+    ``moe_dispatch`` (decode keeps the TRAINED routing semantics — a
+    dense-dispatch-trained MoE must not serve through capacity routing),
+    and ``attn_impl='dense'`` / f32 as serving-safe defaults.  ``overrides``
+    win last — e.g. ``max_seq=...`` to extend the rope table for decode.
+    """
+    from dtdl_tpu.models.transformer import TransformerLM
+    kw = dict(
+        vocab_size=cfg.vocab_size,
+        d_model=cfg.d_model,
+        n_layers=cfg.n_layers,
+        n_heads=cfg.n_heads,
+        d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq,
+        n_experts=cfg.n_experts,
+        moe_every=1,
+        moe_dispatch=cfg.moe_dispatch if cfg.n_experts else "dense",
+        capacity_factor=cfg.capacity_factor,
+        moe_top_k=cfg.moe_top_k,
+        attn_impl="dense",
+        dtype=jnp.float32,
+    )
+    kw.update(overrides)
+    return TransformerLM(**kw)
 
 
 def place_params(mesh: Mesh, cfg: MegatronConfig, params: dict) -> dict:

@@ -29,6 +29,7 @@ import numpy as np
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer
 from dtdl_tpu.data.loader import DataLoader, prefetch_to_device, resume_iter
+from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Reporter, StdoutSink
 from dtdl_tpu.parallel.strategy import SingleDevice, Strategy
 from dtdl_tpu.train.loop import evaluate as _evaluate
@@ -174,6 +175,12 @@ class Estimator:
                 seed=self.config.tf_random_seed)
         train_step = self._compiled["train"]
         cfg = self.config
+        # async dispatch discipline (SCALING.md): the loop dispatches
+        # back-to-back and syncs ONCE per log_step_count_steps — the drain
+        # at the log boundary both fetches the loss and closes the timing
+        # window (so global_step/sec covers finished work, not enqueued
+        # work).  The queue's lag bounds how far the host may run ahead.
+        queue = MetricsQueue(max(cfg.log_step_count_steps, 1))
         t0, logged_at = time.time(), global_step
         # the shuffle order is deterministic in (seed, epoch): resume at the
         # epoch/offset the restored global_step corresponds to, so successive
@@ -194,14 +201,17 @@ class Estimator:
                         break
                     state, metrics = train_step(state, batch)
                     global_step += 1
+                    queue.push(metrics)
                     if (cfg.log_step_count_steps
                             and global_step % cfg.log_step_count_steps == 0):
+                        drained = queue.drain()   # blocks on current step
                         dt = time.time() - t0
                         rate = (global_step - logged_at) / max(dt, 1e-9)
                         t0, logged_at = time.time(), global_step
                         self.reporter.report({
                             "global_step": global_step,
-                            "loss": float(metrics["loss"]),
+                            "loss": drained[-1]["loss"] if drained
+                            else float(metrics["loss"]),
                             "global_step/sec": round(rate, 2),
                         })
                     if (cfg.save_checkpoints_steps

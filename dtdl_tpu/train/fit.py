@@ -23,6 +23,7 @@ import optax
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer, load_weights, save_weights
 from dtdl_tpu.data.loader import DataLoader, prefetch_to_device
+from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Accumulator, Reporter, StdoutSink, TensorBoardSink
 from dtdl_tpu.parallel.strategy import SingleDevice, Strategy
 from dtdl_tpu.train.state import init_state
@@ -185,11 +186,20 @@ class Model:
                     cb.on_epoch_begin(epoch)
                 loader.set_epoch(epoch)
                 acc = Accumulator()
+                # async dispatch discipline (SCALING.md): steps dispatch
+                # back-to-back; the bounded queue converts metrics `lag`
+                # steps behind the dispatch front and the epoch boundary
+                # drains the rest — same floats, same order, no per-step
+                # host↔device stall
+                queue = MetricsQueue()
                 it = prefetch_to_device(iter(loader),
                                         self.strategy.shard_batch)
                 for batch in it:
                     self.state, metrics = self._train_step(self.state, batch)
-                    acc.add({k: float(v) for k, v in metrics.items()})
+                    for vals in queue.push(metrics):
+                        acc.add(vals)
+                for vals in queue.drain():
+                    acc.add(vals)
                 logs = acc.means()
                 if validation_data is not None:
                     vx, vy = validation_data

@@ -5,7 +5,20 @@ pytorch/single_gpu.py:88-120 and pytorch/distributed_data_parallel.py:118-152:
 forward, loss, backward, step, log every 20 batches with loss / running acc /
 batch time).  Here the per-step math lives in the compiled step function;
 this module is the thin host loop around it: feed sharded batches (with
-prefetch), tick the timer honestly (blocking on a metric), and report.
+prefetch), dispatch back-to-back, and report.
+
+**Async dispatch discipline** (SCALING.md): the loop never reads a metric on
+the step it just dispatched.  Device metric pytrees go into a bounded
+:class:`~dtdl_tpu.metrics.device.MetricsQueue`; conversion to Python floats
+happens only at log/epoch boundaries (or by the queue's bounded
+backpressure), so between boundaries the host's only job is enqueueing the
+next step.  Pass ``sync_every_step=True`` to get the legacy blocking loop —
+the values are bitwise identical either way; only *when* the host blocks
+changes.
+
+``unroll=k`` goes further: k prefetched batches are stacked and executed as
+ONE ``lax.scan``-of-k-steps XLA program (state donated, metrics stacked and
+drained once), cutting per-step dispatch overhead by k.
 
 Users who want full control write this loop themselves — these helpers are
 the canonical version the examples share.
@@ -13,41 +26,178 @@ the canonical version the examples share.
 
 from __future__ import annotations
 
+import itertools
+from functools import partial
+
 from dtdl_tpu.data.loader import prefetch_to_device
+from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Accumulator, Reporter
 from dtdl_tpu.parallel.strategy import Strategy
 from dtdl_tpu.utils.timing import StepTimer
 
 
+# bundled-wrapper cache: a fresh jax.jit object per train_epoch call would
+# recompile the scan program every epoch.  A small LRU (not a weak map: the
+# wrapper's closure refs the step fn, so weak keys could never collect)
+# keyed by (id(step), k), holding the step object so an id is never reused
+# while its entry lives; the bound caps pinned executables when a process
+# churns through many distinct step functions.
+from collections import OrderedDict
+
+_BUNDLED_CACHE: OrderedDict = OrderedDict()
+_BUNDLED_CACHE_SIZE = 8
+
+
+def unroll_steps(train_step, k: int):
+    """Bundle ``train_step`` into one XLA program running ``k`` steps.
+
+    Returns ``bundled(state, batches) -> (state, stacked_metrics)`` where
+    ``batches`` is a tuple of (up to) ``k`` already-sharded batch pytrees.
+    The batches are stacked inside the jit and scanned over, so one dispatch
+    covers the whole bundle; ``state`` is donated — its buffers are reused
+    across the scan instead of round-tripping through the host between
+    steps.  A ragged tail bundle (fewer than ``k`` batches) recompiles once
+    for its length.  Wrappers are cached per (train_step, k), so repeated
+    epochs reuse the executable.
+
+    Numerics: the scan body is the same traced step, so the math is
+    identical — for f32 models the results are bitwise equal to the
+    step-at-a-time loop (pinned by test).  XLA may *fuse* the body
+    differently inside the scan, so reduced-precision (bf16) models can
+    differ in last-bit rounding.  When to use: unroll pays when per-step
+    DISPATCH dominates (sub-ms device steps); for compute-bound steps it
+    buys nothing and the stacked-batch copies can even cost a little.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    key = (id(train_step), k)
+    hit = _BUNDLED_CACHE.get(key)
+    if hit is not None and hit[0] is train_step:
+        _BUNDLED_CACHE.move_to_end(key)
+        return hit[1]
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def bundled(state, batches):
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+        return jax.lax.scan(lambda s, b: train_step(s, b), state, stacked)
+
+    _BUNDLED_CACHE[key] = (train_step, bundled)
+    _BUNDLED_CACHE.move_to_end(key)
+    while len(_BUNDLED_CACHE) > _BUNDLED_CACHE_SIZE:
+        _BUNDLED_CACHE.popitem(last=False)
+    return bundled
+
+
+def bundle_batches(it, k: int):
+    """Group an iterator into tuples of ``k`` items (ragged final tuple)."""
+    while True:
+        bundle = tuple(itertools.islice(it, k))
+        if not bundle:
+            return
+        yield bundle
+
+
 def train_epoch(train_step, state, loader, strategy: Strategy,
                 reporter: Reporter | None = None, epoch: int = 0,
                 log_interval: int = 20, timer: StepTimer | None = None,
-                prefetch: int = 2, profile_dir: str | None = None):
+                prefetch: int = 2, profile_dir: str | None = None,
+                sync_every_step: bool = False, lag: int | None = None,
+                unroll: int = 1):
     """Run one epoch; returns (state, epoch_mean_metrics).
+
+    Async by default: metrics are drained (one host↔device sync) once per
+    ``log_interval`` and at the epoch end; ``lag`` bounds the in-flight
+    queue between boundaries (default: ``log_interval``, so backpressure
+    never converts mid-window).  ``sync_every_step=True`` restores the
+    legacy per-step blocking loop (exact per-step batch_time, one stall per
+    step).  ``unroll=k`` dispatches k-step ``lax.scan`` bundles.
 
     ``profile_dir`` captures a jax.profiler (XLA op-level) trace of the
     epoch — the device-side observability the reference lacked (SURVEY §5.1).
     """
     from dtdl_tpu.utils.profiling import maybe_trace, step_annotation
-    timer = timer or StepTimer()
+    if unroll < 1:
+        raise ValueError(f"unroll must be >= 1, got {unroll}")
+    if sync_every_step and unroll > 1:
+        raise ValueError("unroll > 1 dispatches one program per bundle; "
+                         "sync_every_step has no per-step value to block on")
+    timer = timer or StepTimer(blocking=sync_every_step)
     timer.reset_epoch()
     acc = Accumulator()
     loader.set_epoch(epoch)
     steps_per_epoch = len(loader)
+    # a k-step bundle consumes k batches at one dispatch: the prefetch
+    # window must cover it or the bundle assembly itself becomes the stall
+    prefetch = max(prefetch, unroll)
     it = prefetch_to_device(iter(loader), strategy.shard_batch, prefetch)
+
+    if sync_every_step:
+        with maybe_trace(profile_dir):
+            for i, batch in enumerate(it):
+                with step_annotation(i):
+                    state, metrics = train_step(state, batch)
+                timer.step(metrics["loss"])
+                acc.add({k: float(v) for k, v in metrics.items()})
+                if reporter is not None and (i % log_interval) == 0:
+                    reporter.report({
+                        "epoch": epoch, "step": i,
+                        "steps_per_epoch": steps_per_epoch,
+                        **{k: float(v) for k, v in metrics.items()},
+                        "batch_time": timer.last_step_s,
+                    })
+        if reporter is not None:
+            reporter.report({
+                "epoch": epoch, "split": "train_epoch",
+                **acc.means(),
+                "epoch_time": timer.epoch_elapsed_s,
+                "avg_batch_time": timer.avg_step_s,
+            })
+        return state, acc.means()
+
+    queue = MetricsQueue(lag if lag is not None else max(log_interval, 1))
+    if unroll > 1:
+        step_fn = unroll_steps(train_step, unroll)
+        it = bundle_batches(it, unroll)
+    latest: dict | None = None
+    next_log = 0
+    step0 = 0
     with maybe_trace(profile_dir):
-        for i, batch in enumerate(it):
-            with step_annotation(i):
-                state, metrics = train_step(state, batch)
-            timer.step(metrics["loss"])
-            acc.add({k: float(v) for k, v in metrics.items()})
-            if reporter is not None and (i % log_interval) == 0:
+        for batch in it:
+            with step_annotation(step0):
+                if unroll > 1:
+                    state, metrics = step_fn(state, batch)
+                    n = len(batch)
+                else:
+                    state, metrics = train_step(state, batch)
+                    n = 1
+            for _ in range(n):
+                timer.step()
+            popped = queue.push(metrics, count=n)
+            for vals in popped:
+                acc.add(vals)
+            if popped:
+                latest = popped[-1]
+            if reporter is not None and step0 >= next_log:
+                # boundary: ONE drain converts the whole window (blocks on
+                # the just-dispatched step) — the only sync in the window
+                drained = queue.drain()
+                for vals in drained:
+                    acc.add(vals)
+                if drained:
+                    latest = drained[-1]
+                timer.sync()
                 reporter.report({
-                    "epoch": epoch, "step": i,
+                    "epoch": epoch, "step": step0 + n - 1,
                     "steps_per_epoch": steps_per_epoch,
-                    **{k: float(v) for k, v in metrics.items()},
+                    **(latest or {}),
                     "batch_time": timer.last_step_s,
                 })
+                next_log = (step0 // log_interval + 1) * log_interval
+            step0 += n
+    for vals in queue.drain():
+        acc.add(vals)
+    timer.sync()
     if reporter is not None:
         reporter.report({
             "epoch": epoch, "split": "train_epoch",
@@ -78,23 +228,32 @@ def _pad_and_mask(batch, target: int):
 
 def evaluate(eval_step, state, loader, strategy: Strategy,
              reporter: Reporter | None = None, epoch: int = 0,
-             prefetch: int = 2):
+             prefetch: int = 2, lag: int = 8):
     """Full-dataset evaluation; returns exact global mean metrics.
 
     Handles ragged tail batches (DataLoader(drop_last=False)) by padding to
     the loader's batch size with masked rows — every real example counts
     exactly once, unlike the reference's silently-dropped or double-counted
-    tails.
+    tails.  Batches dispatch back-to-back; per-batch sums convert on the
+    queue's bounded backpressure (``lag`` batches behind the dispatch
+    front) and at the final drain, summing in batch order — identical to
+    the synchronous loop's totals.
     """
     target = loader.batch_size
     it = prefetch_to_device(
         (_pad_and_mask(b, target) for b in iter(loader)),
         strategy.shard_batch, prefetch)
+    queue = MetricsQueue(lag)
     sums = {"loss_sum": 0.0, "correct_sum": 0.0, "count": 0.0}
+
+    def absorb(entries):
+        for vals in entries:
+            for k in sums:
+                sums[k] += vals[k]
+
     for batch in it:
-        metrics = eval_step(state, batch)
-        for k in sums:
-            sums[k] += float(metrics[k])
+        absorb(queue.push(eval_step(state, batch)))
+    absorb(queue.drain())
     if sums["count"] == 0:
         return {"loss": float("nan"), "accuracy": float("nan")}
     means = {"loss": sums["loss_sum"] / sums["count"],

@@ -33,6 +33,7 @@ import optax
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer
 from dtdl_tpu.data.loader import LimitBatches, prefetch_to_device, resume_iter
+from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Reporter, StdoutSink
 from dtdl_tpu.train.loop import evaluate as _evaluate
 from dtdl_tpu.models.netspec import build_net
@@ -227,6 +228,12 @@ class Solver:
             self.reporter.report({"iter": self.iteration, **self.test()})
         last: dict = {}
         metrics = None
+        # async dispatch discipline (SCALING.md): per-update metrics stay
+        # on device in a bounded queue; the ONE drain per display boundary
+        # (or at the end) converts them, so the hot loop never blocks on
+        # the iteration it just dispatched
+        queue = MetricsQueue(max(display, 1) if display else 8)
+        newest: dict = {}
         try:
             steps_per_pass = len(self.train_loader)
         except TypeError:
@@ -254,8 +261,14 @@ class Solver:
                     if batches % iter_size:
                         continue  # mid-accumulation: not an iteration yet
                     self.iteration += 1
+                    popped = queue.push(metrics)
+                    if popped:
+                        newest = popped[-1]
                     if display and self.iteration % display == 0:
-                        last = {k: float(v) for k, v in metrics.items()}
+                        drained = queue.drain()   # the window's one sync
+                        if drained:
+                            newest = drained[-1]
+                        last = newest
                         self.reporter.report({"iter": self.iteration, **last})
                     if (test_interval and self.test_loader is not None
                             and self.iteration % test_interval == 0):
@@ -264,7 +277,8 @@ class Solver:
                     if snap and self.iteration % snap == 0:
                         self.snapshot()
             if not last and metrics is not None:
-                last = {k: float(v) for k, v in metrics.items()}
+                drained = queue.drain()
+                last = drained[-1] if drained else newest
             if snap:
                 self.snapshot()
         finally:

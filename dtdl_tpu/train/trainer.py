@@ -27,6 +27,7 @@ import time
 
 from dtdl_tpu.ckpt.checkpoint import Checkpointer
 from dtdl_tpu.data.loader import prefetch_to_device, resume_iter
+from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Accumulator, JsonlSink, Reporter, StdoutSink
 from dtdl_tpu.parallel.strategy import Strategy
 from dtdl_tpu.runtime.bootstrap import is_leader
@@ -77,7 +78,7 @@ class Trainer:
 
     def __init__(self, state, train_step, train_loader, strategy: Strategy,
                  stop_trigger=(20, "epoch"), out: str = "./result",
-                 prefetch: int = 2):
+                 prefetch: int = 2, metrics_lag: int = 20):
         self.state = state
         self.train_step = train_step
         self.train_loader = train_loader
@@ -92,7 +93,12 @@ class Trainer:
         self._skip_batches = 0  # fast-forward after a mid-epoch resume
         self.observation: dict[str, float] = {}
         self.accumulator = Accumulator()
-        self.timer = StepTimer()
+        # async dispatch discipline (SCALING.md): metrics stay on device in
+        # a bounded queue; they are drained — ONE host sync — right before
+        # any extension actually fires, so back-to-back iterations never
+        # block on the step they just dispatched
+        self.metrics_queue = MetricsQueue(metrics_lag)
+        self.timer = StepTimer(blocking=False)
         self.start_time = time.time()
         self._extensions: list[tuple[str, Extension, Trigger]] = []
         self.ckpt = Checkpointer(out)  # creates out/ (leader-gated)
@@ -111,6 +117,24 @@ class Trainer:
         for _, ext, trig in self._extensions:
             if trig.should_fire(self, boundary):
                 ext(self)
+
+    def _will_fire(self, boundary: str) -> bool:
+        return any(trig.should_fire(self, boundary)
+                   for _, _, trig in self._extensions)
+
+    def _drain_metrics(self) -> None:
+        """Settle pending device metrics into observation/accumulator.
+
+        The drained floats land in dispatch order, so the accumulator's
+        per-period means and the final ``observation`` are bitwise what the
+        old sync-every-iteration loop produced.
+        """
+        drained = self.metrics_queue.drain()
+        for vals in drained:
+            self.observation = vals
+            self.accumulator.add(vals)
+        if drained:
+            self.timer.sync()
 
     # -- run loop -------------------------------------------------------------
 
@@ -150,15 +174,19 @@ class Trainer:
                 self.state, metrics = self.train_step(self.state, batch)
                 self.iteration += 1
                 self.iteration_in_epoch += 1
-                self.timer.step(metrics["loss"])
-                self.observation = {
-                    k: float(v) for k, v in metrics.items()}
-                self.accumulator.add(self.observation)
+                self.timer.step()
+                for vals in self.metrics_queue.push(metrics):
+                    self.observation = vals
+                    self.accumulator.add(vals)
+                done = self._done and self.stop.unit == "iteration"
+                if done or self._will_fire("iteration"):
+                    self._drain_metrics()
                 self._fire("iteration")
-                if self._done and self.stop.unit == "iteration":
+                if done:
                     return
             self.epoch += 1
             self.iteration_in_epoch = 0
+            self._drain_metrics()
             self._fire("epoch")
 
     # -- snapshot / resume ----------------------------------------------------

@@ -3,9 +3,21 @@
 The reference hand-rolls per-batch ``time.time()`` deltas and per-epoch
 ``datetime.timedelta`` prints in every training loop (reference
 pytorch/distributed_data_parallel.py:122-152).  `StepTimer` is the factored
-equivalent: it tracks batch time, running averages, and epoch elapsed time, and
-knows that under JAX the step is async — it calls ``block_until_ready`` on a
-representative output before reading the clock so timings are honest.
+equivalent: it tracks batch time, running averages, and epoch elapsed time.
+
+Under JAX the step is async, so honest timing needs a device sync — but a
+sync *per step* stalls the dispatch pipeline (SCALING.md "Async dispatch
+discipline").  `StepTimer` therefore has two modes:
+
+* ``blocking=True`` (default, the legacy behavior): ``step(*blockers)``
+  calls ``block_until_ready`` on a representative output and reads the
+  clock every step — exact per-step times, one pipeline stall each.
+* ``blocking=False``: ``step()`` only counts dispatches; :meth:`sync` —
+  called once per log window, after the window's metrics were drained —
+  blocks and attributes the window's wall time evenly over its steps.
+  Per-step numbers become *honest window averages* instead of exact
+  per-step samples, and the loop between boundaries never touches the
+  device.
 """
 
 from __future__ import annotations
@@ -21,7 +33,8 @@ def fmt_timedelta(seconds: float) -> str:
 class StepTimer:
     """Tracks per-step wall time and epoch elapsed time."""
 
-    def __init__(self):
+    def __init__(self, blocking: bool = True):
+        self.blocking = blocking
         self.reset_epoch()
 
     def reset_epoch(self) -> None:
@@ -30,9 +43,21 @@ class StepTimer:
         self.last_step_s = 0.0
         self.total_steps = 0
         self._sum_step_s = 0.0
+        # non-blocking window bookkeeping (steps dispatched since last sync)
+        self._window_start = self.epoch_start
+        self._window_steps = 0
 
     def step(self, *blockers) -> float:
-        """Mark the end of a step; pass device arrays to block on first."""
+        """Mark the end of a step; pass device arrays to block on first.
+
+        Non-blocking mode ignores ``blockers`` and only counts the dispatch
+        — the window is settled at the next :meth:`sync`.  The return value
+        is the latest known per-step time (stale until then).
+        """
+        if not self.blocking:
+            self.total_steps += 1
+            self._window_steps += 1
+            return self.last_step_s
         for b in blockers:
             try:
                 b.block_until_ready()
@@ -43,6 +68,32 @@ class StepTimer:
         self._step_start = now
         self.total_steps += 1
         self._sum_step_s += self.last_step_s
+        # keep the window anchored so a later sync() never double-counts
+        self._window_start = now
+        self._window_steps = 0
+        return self.last_step_s
+
+    def sync(self, *blockers) -> float:
+        """Settle the current window: block, then average it over its steps.
+
+        Call at a log/epoch boundary *after* draining the window's metrics
+        (the drain's ``float()`` already forced the dependency chain; any
+        extra ``blockers`` are belt-and-braces).  Returns the window's
+        per-step average, which also becomes :attr:`last_step_s`.
+        """
+        for b in blockers:
+            try:
+                b.block_until_ready()
+            except AttributeError:
+                pass
+        now = time.perf_counter()
+        if self._window_steps:
+            window = now - self._window_start
+            self.last_step_s = window / self._window_steps
+            self._sum_step_s += window
+        self._window_start = now
+        self._window_steps = 0
+        self._step_start = now
         return self.last_step_s
 
     @property

@@ -210,7 +210,7 @@ def main():
             if ckpt and ((args.ckpt_interval and done % args.ckpt_interval
                           == 0) or done == args.steps):
                 ckpt.save(done, {"params": params, "opt_state": opt_state,
-                                 "step": np.int64(done)})
+                                 "step": np.asarray(done, np.int64)})
     finally:
         if ckpt:
             ckpt.wait_until_finished()
@@ -229,18 +229,10 @@ def main():
             # steps get one-slot groups, so decode never drops); the
             # rope table is extended to fit the requested decode length
             # (rows depend only on position — numerically identical)
-            from dtdl_tpu.models import generate, transformer_lm
+            from dtdl_tpu.models import generate
             flax_p = M.to_flax_params(cfg, jax.device_get(params))
-            lm = transformer_lm(
-                "tiny", vocab_size=vocab, d_model=cfg.d_model,
-                n_layers=cfg.n_layers, n_heads=cfg.n_heads,
-                d_ff=cfg.d_ff,
-                max_seq=max(args.seq_len, 8 + args.generate_tokens),
-                attn_impl="dense",
-                n_experts=cfg.n_experts, moe_every=1,
-                moe_dispatch="routed" if cfg.n_experts else "dense",
-                capacity_factor=cfg.capacity_factor,
-                moe_top_k=cfg.moe_top_k, dtype=jnp.float32)
+            lm = M.to_flax_model(
+                cfg, max_seq=max(args.seq_len, 8 + args.generate_tokens))
             prompt = jnp.asarray(train_tokens[:1, :8], jnp.int32)
             toks_out = generate(lm, flax_p, prompt,
                                 max_new_tokens=args.generate_tokens)

@@ -18,17 +18,29 @@ Run:  python scripts/pp_schedule_bench.py
 """
 import importlib.util
 import json
+import os
 import subprocess
 import sys
 import tempfile
 import time
 
-import jax
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS fallback above supplies the devices
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, REPO_ROOT)
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -39,7 +51,7 @@ LOCKSTEP_REV = "87ed655"
 
 def load_old_megatron():
     src = subprocess.run(
-        ["git", "-C", "/root/repo", "show",
+        ["git", "-C", REPO_ROOT, "show",
          f"{LOCKSTEP_REV}:dtdl_tpu/parallel/megatron.py"],
         capture_output=True, text=True, check=True).stdout
     with tempfile.NamedTemporaryFile("w", suffix="_megatron_old.py",

@@ -255,19 +255,64 @@ def test_rollback_supersedes_stale_future_snapshots(tmp_path):
 
 
 def test_epoch_weights_rollback_supersedes_stale_futures(tmp_path):
-    """Same timeline rule for per-epoch weights: re-saving epoch e deletes
-    later epochs so latest_weights() never restores a stale future."""
+    """Same timeline rule for per-epoch weights: after this run RESTORED,
+    re-saving epoch e deletes later epochs so latest_weights() never
+    restores a stale future.  (Without a restore the guard below applies —
+    a fresh run must not delete a previous run's epochs.)"""
     ck = Checkpointer(str(tmp_path), keep=4)
     for e in range(4):
         ck.save_weights_epoch(e, mk_state(seed=e).params)
-    ck.save_weights_epoch(1, mk_state(seed=41).params)
     like = jax.device_get(mk_state().params)
+    ck.latest_weights(like)          # this run is now timeline-owning
+    ck.save_weights_epoch(1, mk_state(seed=41).params)
     params, epoch = ck.latest_weights(like)
     assert epoch == 1
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(
         np.asarray(a), np.asarray(b)),
         jax.device_get(mk_state(seed=41).params), params)
     assert sorted(ck._list(ck._WEIGHT_RE)) == [0, 1]
+
+
+def test_fresh_run_never_supersedes_existing_snapshots(tmp_path):
+    """Data-loss guard (ADVICE round 5): a brand-new Checkpointer pointed
+    at a directory holding an older run's snapshots starts its step counter
+    low — that is NOT a rollback, and the older run's higher-step snapshots
+    (and epoch weights) must survive the save."""
+    import os
+
+    old = Checkpointer(str(tmp_path))
+    state = mk_state()
+    for s in (150, 200):
+        old.save(s, state, wait=True)
+    old.save_weights_epoch(7, state.params)
+    old.close()
+
+    fresh = Checkpointer(str(tmp_path))   # e.g. a rerun with a new config
+    fresh.save(10, state, wait=True)
+    fresh.save_weights_epoch(0, state.params)
+    assert sorted(fresh._list(fresh._SNAP_RE)) == [10, 150, 200]
+    assert sorted(fresh._list(fresh._WEIGHT_RE)) == [0, 7]
+    assert os.path.isdir(str(tmp_path / "snapshot_200"))
+    # a warm start from an EXTERNAL run's snapshot is not a rollback of
+    # this directory either — its timeline must still survive a low save
+    other = Checkpointer(str(tmp_path) + "_other")
+    other.save(90, state, wait=True)
+    other.close()
+    fresh.restore_path(mk_state(seed=3),
+                       str(tmp_path) + "_other/snapshot_90")
+    fresh.save(11, state, wait=True)
+    assert sorted(fresh._list(fresh._SNAP_RE)) == [10, 11, 150, 200]
+    # the flags are per shape: restoring a full-state snapshot must not
+    # arm the epoch-weights supersede
+    restored, step = fresh.restore(mk_state(seed=3), step=150)
+    assert step == 150
+    fresh.save_weights_epoch(1, state.params)
+    assert sorted(fresh._list(fresh._WEIGHT_RE)) == [0, 1, 7]
+    # only after restoring from THIS directory does a low save rewrite
+    # the snapshot timeline
+    fresh.save(160, state, wait=True)
+    assert sorted(fresh._list(fresh._SNAP_RE)) == [10, 11, 150, 160]
+    fresh.close()
 
 
 def test_validate_rejects_structure_mismatch(tmp_path):

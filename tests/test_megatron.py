@@ -487,7 +487,7 @@ def test_4d_checkpoint_resume_equivalence(devices, tmp_path):
     params, opt_state, _ = run(params, opt_state, batches[:3])
     c1 = Checkpointer(str(tmp_path))
     c1.save(3, {"params": params, "opt_state": opt_state,
-                "step": np.int64(3)}, wait=True)
+                "step": np.asarray(3, np.int64)}, wait=True)
     c1.close()
 
     c2 = Checkpointer(str(tmp_path))
@@ -560,3 +560,45 @@ def test_moe_aux_loss_flattens_expert_utilization(devices):
                                                             drops[-5:])
     assert np.mean(auxes[-5:]) < np.mean(auxes[:5]), (auxes[:5], auxes[-5:])
     assert np.mean(auxes[-5:]) < 1.1   # near the balanced optimum of 1.0
+
+
+def test_4d_eval_step_rejects_bad_microbatch_split(devices):
+    """An eval batch whose local size does not divide into n_microbatches
+    must fail with a ValueError naming the constraint BEFORE shard_map
+    tracing turns it into an opaque reshape error."""
+    cfg = _cfg(n_microbatches=2)
+    mesh = M.build_4d_mesh(devices)
+    params = M.place_params(mesh, cfg,
+                            M.init_params(cfg, jax.random.PRNGKey(0)))
+    eval_step = M.make_megatron_eval_step(cfg, mesh)
+    # data axis is 1 on the test mesh: global batch 3 -> b_loc 3, and
+    # 3 % n_microbatches(2) != 0
+    bad = M.shard_lm_batch(mesh, _batch(cfg, B=3))
+    with pytest.raises(ValueError, match="n_microbatches"):
+        eval_step(params, bad["tokens"], bad["targets"], bad["mask"])
+
+
+def test_to_flax_model_mirrors_config():
+    """to_flax_model is the single MegatronConfig -> TransformerLM mapping
+    (the serving bridge's model half): geometry mirrors the config, the
+    bridge-mandated fields are pinned, and overrides win."""
+    cfg = _cfg(n_experts=4, moe_top_k=2, capacity_factor=2.0)
+    lm = M.to_flax_model(cfg)
+    assert (lm.vocab_size, lm.d_model, lm.n_layers, lm.n_heads, lm.d_ff,
+            lm.max_seq) == (cfg.vocab_size, cfg.d_model, cfg.n_layers,
+                            cfg.n_heads, cfg.d_ff, cfg.max_seq)
+    assert lm.head_dim == cfg.head_dim
+    # bridge-mandated: megatron puts an MoE in EVERY block, and decode
+    # keeps the trained routed-capacity semantics
+    assert lm.moe_every == 1
+    assert lm.n_experts == 4 and lm.moe_top_k == 2
+    assert lm.moe_dispatch == "routed" and lm.capacity_factor == 2.0
+    assert lm.attn_impl == "dense" and lm.dtype == jnp.float32
+    dense = M.to_flax_model(_cfg())
+    assert dense.moe_dispatch == "dense" and dense.n_experts == 0
+    # a dense-dispatch-trained MoE keeps dense dispatch at serving time —
+    # routing semantics must be the TRAINED ones, not a bridge default
+    oracle = M.to_flax_model(_cfg(n_experts=4, moe_dispatch="dense"))
+    assert oracle.n_experts == 4 and oracle.moe_dispatch == "dense"
+    # overrides win last (e.g. a longer rope table for decode)
+    assert M.to_flax_model(cfg, max_seq=4096).max_seq == 4096

@@ -327,11 +327,17 @@ def test_chunked_lm_loss_correct_sum_mask_grad():
     np.testing.assert_array_equal(np.asarray(g), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_lm_step_trains_with_moe_aux_loss():
     """The flax MoE path's sow'd Switch balance loss is consumed by
     make_lm_train_step and ADDED to the training loss (same contract as
     the megatron path) — without the mutable=['aux_loss'] collection the
-    sow is silently dropped and routing trains with no balance pressure."""
+    sow is silently dropped and routing trains with no balance pressure.
+
+    slow: compiles the routed-MoE LM step twice (two strategies) and
+    trains 30 steps on the virtual-CPU mesh (~70 s) — the single largest
+    line item in the tier-1 wall clock, which runs uncached (see
+    tests/conftest.py on the compile-cache segfault)."""
     import optax
     from dtdl_tpu.parallel import DataParallel, SingleDevice
     from dtdl_tpu.train import init_state, make_lm_train_step
