@@ -315,6 +315,75 @@ def bench_host_overhead(steps: int = 192, batch_size: int = 64,
     return row
 
 
+def bench_serving(size: str = None, slot_sweep=(1, 4, 8),
+                  new_tokens: int = 32) -> dict:
+    """Serving throughput: prefill vs decode tokens/sec vs batch size.
+
+    Drives the dtdl_tpu.serve engine directly (no scheduler policy in the
+    timed region): for each slot count B, prefill B prompts of one bucket
+    and run ``new_tokens`` batched decode steps.  The two phases are timed
+    separately because they sit on opposite ends of the roofline — prefill
+    is one matmul-heavy pass over the whole prompt (compute-bound), decode
+    re-reads every weight once per token (HBM-bandwidth-bound), which is
+    why decode tokens/sec should scale near-linearly with B until the KV
+    reads catch up with the weight reads (SCALING.md "Serving latency
+    model").  Value fetch ends each timed region, per the module contract.
+    """
+    import flax.linen as nn
+    from dtdl_tpu.models import transformer_lm
+    from dtdl_tpu.serve import InferenceEngine
+
+    if size is None:
+        size = "tiny" if jax.devices()[0].platform == "cpu" else "base"
+    model = transformer_lm(size, attn_impl="dense", dtype=jnp.float32)
+    prompt_len = min(model.max_seq // 2, 512)
+    new_tokens = min(new_tokens, model.max_seq - prompt_len)
+    params = nn.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    rng = np.random.default_rng(0)
+    row = {"model": "serving", "size": size, "prompt_len": prompt_len,
+           "new_tokens": new_tokens, "sweep": []}
+    for B in slot_sweep:
+        engine = InferenceEngine(model, params, n_slots=B,
+                                 buckets=(prompt_len,))
+        greedy = (jnp.zeros(B), jnp.zeros(B, jnp.int32), jnp.ones(B))
+        key = jax.random.PRNGKey(0)
+        prompts = [rng.integers(0, model.vocab_size, prompt_len)
+                   for _ in range(B)]
+
+        def fill(arena, last):
+            for slot, p in enumerate(prompts):
+                arena, last, _ = engine.prefill(arena, last, slot, p)
+            return arena, last
+
+        # warmup: compile prefill + decode once
+        arena, last = fill(engine.init_arena(), engine.init_last_tokens())
+        arena, last, _ = engine.decode(arena, last, np.ones(B, bool),
+                                       key, *greedy)
+        # timed prefill (fresh arena, same compiled program)
+        arena, last = engine.init_arena(), engine.init_last_tokens()
+        t0 = time.perf_counter()
+        arena, last = fill(arena, last)
+        np.asarray(last)
+        dt_prefill = time.perf_counter() - t0
+        # timed decode at full occupancy
+        active = np.ones(B, bool)
+        t0 = time.perf_counter()
+        for _ in range(new_tokens):
+            arena, last, _ = engine.decode(arena, last, active, key,
+                                           *greedy)
+        np.asarray(last)
+        dt_decode = time.perf_counter() - t0
+        row["sweep"].append({
+            "batch_size": B,
+            "prefill_tokens_per_sec": round(B * prompt_len / dt_prefill, 1),
+            "decode_tokens_per_sec": round(B * new_tokens / dt_decode, 1),
+            "decode_ms_per_token": round(
+                1e3 * dt_decode / new_tokens, 3),
+        })
+    return row
+
+
 # ---------------------------------------------------------------------------
 # modeled multi-chip scaling (SCALING.md)
 #
@@ -590,6 +659,12 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-host-overhead", action="store_true",
                    help="skip the sync/async/unrolled host-overhead "
                         "microbench row")
+    p.add_argument("--skip-serving", action="store_true",
+                   help="skip the serving (prefill/decode tokens/sec vs "
+                        "batch size) row")
+    p.add_argument("--serve-size", default=None,
+                   help="LM size for the serving row (default: tiny on "
+                        "CPU, base on an accelerator)")
     a = p.parse_args(argv)
 
     if a.quick:
@@ -654,6 +729,18 @@ def main(argv=None) -> dict:
         records.append(host_row)
         print("  " + json.dumps(host_row), file=sys.stderr, flush=True)
 
+    serve_row = None
+    if not a.skip_serving:
+        # serving row: prefill vs decode tokens/sec vs batch size — the
+        # first workload receipt of the serve/ subsystem (ISSUE 2)
+        try:
+            serve_row = bench_serving(size=a.serve_size)
+        except Exception as e:  # the serving row must never sink the bench
+            serve_row = {"model": "serving",
+                         "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(serve_row)
+        print("  " + json.dumps(serve_row), file=sys.stderr, flush=True)
+
     ok = [r for r in records if "samples_per_sec" in r]
     # headline = the best-MFU row of the reference-parity model (pyramidnet),
     # so vs_baseline stays an apples-to-apples per-sample ratio against the
@@ -717,6 +804,13 @@ def main(argv=None) -> dict:
     if host_row and "async_speedup_vs_sync" in host_row:
         summary["host_overhead_async_speedup"] = \
             host_row["async_speedup_vs_sync"]
+    if serve_row and serve_row.get("sweep"):
+        best_d = max(serve_row["sweep"],
+                     key=lambda s: s["decode_tokens_per_sec"])
+        summary["serve_decode_tokens_per_sec"] = \
+            best_d["decode_tokens_per_sec"]
+        summary["serve_prefill_tokens_per_sec"] = max(
+            s["prefill_tokens_per_sec"] for s in serve_row["sweep"])
 
     full = dict(summary)
     full["records"] = records

@@ -28,6 +28,18 @@ import functools
 import jax
 import jax.lax
 
+# True once install() had to patch shard_map, i.e. this jax predates the
+# vma type system.  Code whose CORRECTNESS (not just spelling) depends on
+# vma-typed autodiff must gate on this: with ``check_rep=False`` the
+# legacy shard_map transposes ``psum`` to ``psum`` (verified here:
+# grad(psum(sum(x))) returns the axis size instead of 1) and inserts no
+# pbroadcast-transposes for replicated operands, so differentiating
+# *through* collectives inside shard_map yields wrong gradients —
+# shard-local, mis-scaled.  Explicit-VJP code (the 1F1B schedule) is
+# unaffected: its psums are data movement in a hand-written backward,
+# never autodiff'd through.
+SHIMMED = False
+
 
 class _AvalView:
     """Proxy of an abstract value that answers ``.vma`` on legacy jax."""
@@ -44,7 +56,9 @@ class _AvalView:
 
 def install() -> None:
     """Idempotently install the shims (no-op on current jax)."""
+    global SHIMMED
     if not hasattr(jax, "shard_map"):
+        SHIMMED = True
         from jax.experimental.shard_map import shard_map as _shard_map
 
         @functools.wraps(_shard_map)

@@ -10,7 +10,8 @@ from dtdl_tpu.models.cnn import MnistCNN  # noqa: F401
 from dtdl_tpu.models.pyramidnet import PyramidNet, pyramidnet  # noqa: F401
 from dtdl_tpu.models.resnet import ResNet, ResNet50, resnet50  # noqa: F401
 from dtdl_tpu.models.transformer import (  # noqa: F401
-    TransformerLM, generate, transformer_lm,
+    CacheOverflowError, TransformerLM, cache_max_seq, generate,
+    transformer_lm,
 )
 from dtdl_tpu.models.netspec import CaffeNet, build_net  # noqa: F401
 

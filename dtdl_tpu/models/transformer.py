@@ -38,6 +38,32 @@ from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
 Dtype = Any
 
 
+class CacheOverflowError(ValueError):
+    """Decode would write past the KV cache / rope table (``max_seq``).
+
+    Raised eagerly whenever the cache index is a concrete value (plain
+    ``model.apply(..., mutable=['cache'])`` outside jit).  Inside a
+    compiled program the index is a tracer and cannot be checked here —
+    ``generate`` validates ``prompt + max_new_tokens <= max_seq`` before
+    tracing, and the serving scheduler (dtdl_tpu/serve/scheduler.py)
+    retires a slot the moment its sequence reaches ``cache_max_seq`` —
+    without a caller-level guard the cache index would silently clamp
+    into the last position and corrupt it.
+    """
+
+
+def cache_max_seq(cache) -> int:
+    """The ``max_seq`` a KV cache was built for (its rope-table length).
+
+    Reads the [.., max_seq, head_dim] K/V buffer shape, so it works on a
+    live cache pytree, the ``jax.eval_shape`` result, or a serving arena.
+    """
+    for leaf in jax.tree.leaves(cache):
+        if getattr(leaf, "ndim", 0) >= 3:
+            return int(leaf.shape[-2])
+    raise ValueError("no K/V buffers in cache pytree")
+
+
 def _part(init, *names):
     return nn.with_logical_partitioning(init, names)
 
@@ -113,10 +139,23 @@ class Attention(nn.Module):
         is NOT for; long prefills are chunked over query rows
         (``PREFILL_CHUNK``) to keep the same O(seq) memory bound.
         Mutate via ``apply(..., mutable=['cache'])``.
+
+        The cache ``index`` may be a scalar (every row at the same
+        position — the ``generate`` path) or a **[B] vector of per-row
+        positions** (the serving arena: each batch row is an independent
+        slot at its own decode position, so one compiled step serves a
+        continuously-batched mix of sequence lengths).  The vector path
+        is single-token only (S = 1) — prefill happens per slot at
+        scalar index and is scattered into the arena by the engine
+        (dtdl_tpu/serve/engine.py).
         """
         import math
         b, h, s_new, d = q.shape
         max_len = cos.shape[0]
+        if s_new > max_len:
+            raise CacheOverflowError(
+                f"{s_new} new tokens cannot fit a max_seq={max_len} "
+                f"KV cache/rope table")
         # has_variable BEFORE self.variable: during the init trace the
         # cache does not exist yet, and mutating it there would bake the
         # example input into the returned cache and leave index=1 — every
@@ -132,6 +171,20 @@ class Attention(nn.Module):
             # this IS the init trace: shapes only, no cache mutation
             return jnp.zeros_like(q)
         pos = ci.value
+        if not isinstance(pos, jax.core.Tracer):
+            # eager decode: the index is concrete, so overflow is
+            # checkable HERE instead of silently clamping the write into
+            # the last cache row (jitted callers must bound-check before
+            # tracing — see CacheOverflowError)
+            limit = int(jnp.max(pos)) if pos.ndim else int(pos)
+            if limit + s_new > max_len:
+                raise CacheOverflowError(
+                    f"decode at position {limit} with {s_new} new "
+                    f"token(s) exceeds max_seq={max_len}; the cache "
+                    f"index would clamp and corrupt the last row")
+        if pos.ndim:
+            return self._decode_attend_slots(q, k, v, cos, sin,
+                                             ck, cv, ci, pos)
         q = apply_rope(q, cos, sin, offset=pos)
         k = apply_rope(k, cos, sin, offset=pos)
         ck.value = jax.lax.dynamic_update_slice(
@@ -170,6 +223,42 @@ class Attention(nn.Module):
                           (q_blocks, pos_blocks))
         out = jnp.moveaxis(out, 0, 2).reshape(b, h, s_new + pad, d)
         return out[:, :, :s_new]
+
+    def _decode_attend_slots(self, q, k, v, cos, sin, ck, cv, ci, pos):
+        """Vector-index decode: row b is an independent slot at position
+        ``pos[b]``.  Same math as the scalar path per row — rope at the
+        row's own global position, K/V scattered into the row's cache at
+        ``pos[b]``, causal mask per row — so a continuously-batched step
+        is token-identical to stepping each slot alone (pinned by
+        tests/test_serve.py).
+        """
+        import math
+        b, h, s_new, d = q.shape
+        max_len = cos.shape[0]
+        if s_new != 1:
+            raise ValueError(
+                f"a per-slot (vector-index) cache decodes one token per "
+                f"row at a time, got {s_new}; prefill per slot at scalar "
+                f"index and scatter into the arena instead")
+        rope_row = jax.vmap(
+            lambda xb, p: apply_rope(xb[None], cos, sin, offset=p)[0])
+        q = rope_row(q, pos)
+        k = rope_row(k, pos)
+        scatter_row = jax.vmap(
+            lambda buf, new, p: jax.lax.dynamic_update_slice(
+                buf, new, (0, p, 0)))
+        ck.value = scatter_row(ck.value, k.astype(self.dtype), pos)
+        cv.value = scatter_row(cv.value, v.astype(self.dtype), pos)
+        ci.value = pos + 1
+
+        scale = 1.0 / math.sqrt(d)
+        mask = jnp.arange(max_len)[None, :] <= pos[:, None]     # [B, max]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
+                            preferred_element_type=jnp.float32)
+        logits = jnp.where(mask[:, None, None, :], logits * scale, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd",
+                          probs.astype(self.dtype), cv.value)
 
 
 class SwiGLU(nn.Module):
@@ -418,6 +507,29 @@ class TransformerLM(nn.Module):
     @property
     def head_dim(self):
         return self.d_model // self.n_heads
+
+    def cache_shapes(self, batch_size: int, per_slot_index: bool = False):
+        """Abstract (ShapeDtypeStruct) KV-cache pytree for ``batch_size``
+        rows — one [B, H, max_seq, head_dim] K/V buffer pair + position
+        index per block, no compute (``jax.eval_shape`` of the decode
+        init trace).  ``per_slot_index=True`` widens the index leaves from
+        a scalar to [B] — the serving-arena layout where each row is an
+        independent slot at its own decode position."""
+        shapes = jax.eval_shape(
+            functools.partial(self.init, decode=True),
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch_size, 1), jnp.int32))["cache"]
+        if per_slot_index:
+            shapes = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct((batch_size,), s.dtype)
+                if s.ndim == 0 else s, shapes)
+        return shapes
+
+    def init_cache(self, batch_size: int, per_slot_index: bool = False):
+        """Fresh zero KV cache (see :meth:`cache_shapes`); ``max_seq`` of
+        the result is recoverable via :func:`cache_max_seq`."""
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            self.cache_shapes(batch_size, per_slot_index))
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,

@@ -49,6 +49,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dtdl_tpu import _compat
 from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
 from dtdl_tpu.parallel.sequence import (
     ring_attention, zigzag_order, zigzag_positions,
@@ -984,6 +985,22 @@ def make_megatron_train_step(cfg: MegatronConfig, mesh: Mesh, optimizer):
     if cfg.schedule == "gpipe" and cfg.virtual_stages != 1:
         raise ValueError("virtual_stages (interleaved schedule) requires "
                          "schedule='1f1b'")
+    if cfg.schedule == "gpipe" and _compat.SHIMMED:
+        # the GPipe schedule is jax.value_and_grad THROUGH shard_map; that
+        # is only correct under vma-typed autodiff (current jax).  The
+        # legacy check_rep=False shard_map transposes psum to psum and
+        # skips the pbroadcast-transposes for replicated params, so the
+        # loss comes out right but the GRADS come out shard-local and
+        # mis-scaled (up to ~10% on the embedding in the oracle tests,
+        # structurally — not fp drift).  Refuse loudly instead of
+        # training garbage; 1f1b (the default) is the same math through
+        # a hand-written VJP and is verified against the oracle on this
+        # jax.  Forward-only GPipe (make_megatron_eval_step) is fine.
+        raise ValueError(
+            "schedule='gpipe' differentiates through shard_map "
+            "collectives, which legacy jax (no vma-typed autodiff; see "
+            "dtdl_tpu/_compat.py SHIMMED) gets wrong — use the default "
+            "schedule='1f1b' on this jax version")
 
     def step(params, opt_state, tokens, targets, mask):
         if cfg.schedule == "1f1b":
@@ -1297,3 +1314,38 @@ def place_params(mesh: Mesh, cfg: MegatronConfig, params: dict) -> dict:
     specs = param_specs(cfg)
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
+                 n_slots: int = 8, buckets=None, **overrides):
+    """Train on the 4D engine, serve through dtdl_tpu.serve — the full
+    bridge in one call: :func:`to_flax_model` (geometry) +
+    :func:`to_flax_params` (weights) + an
+    :class:`~dtdl_tpu.serve.InferenceEngine` around them.
+
+    With ``mesh``, the converted params are placed **replicated** on it
+    (``NamedSharding(mesh, P())``) and the engine's jitted prefill/decode
+    programs run under GSPMD on that mesh — the same pjit machinery the
+    training step used, so a training pod flips to serving without a new
+    runtime.  Replication is the right default at serving batch sizes:
+    decode is HBM-bandwidth-bound on the weights (SCALING.md "Serving
+    latency model"), and every chip holding all weights turns the mesh
+    into throughput-parallel decode capacity.  Tensor-parallel serving of
+    models too big to replicate would pass sharded placements instead —
+    the engine is placement-agnostic (jit re-specializes per input
+    sharding).
+
+    ``params`` may be the live sharded training state (``device_get`` is
+    applied before conversion).  ``overrides`` reach
+    :func:`to_flax_model` — e.g. ``max_seq=4096`` to serve longer than
+    the trained context.
+    """
+    from dtdl_tpu.serve import InferenceEngine
+
+    model = to_flax_model(cfg, **overrides)
+    fparams = to_flax_params(cfg, jax.device_get(params))
+    if mesh is not None:
+        fparams = jax.tree.map(
+            lambda p: jax.device_put(p, NamedSharding(mesh, P())), fparams)
+    return InferenceEngine(model, fparams, n_slots=n_slots,
+                           buckets=buckets)

@@ -1,0 +1,24 @@
+"""Batched LM serving: compiled prefill/decode pair + continuous batcher.
+
+The request path the training stack feeds (ROADMAP north star: serve
+heavy traffic): train anywhere (flax/GSPMD or the 4D megatron engine),
+bridge to the flax model, and drive it here —
+
+    engine = InferenceEngine(model, params, n_slots=8)
+    sched = Scheduler(engine)
+    sched.submit(Request(prompt, max_new_tokens=64))
+    done = sched.run()
+
+See engine.py (the two-XLA-program contract), scheduler.py (slot-based
+continuous batching), sampling.py (per-slot greedy/temperature/top-k/
+top-p), metrics.py (async serving telemetry).
+"""
+
+from dtdl_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine, default_buckets,
+)
+from dtdl_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from dtdl_tpu.serve.sampling import (  # noqa: F401
+    GREEDY, SampleParams, sample,
+)
+from dtdl_tpu.serve.scheduler import Request, Scheduler  # noqa: F401

@@ -1,0 +1,211 @@
+"""Batched LM inference engine: two XLA programs, a slotted KV arena.
+
+The serving problem on TPU is a *compile-shape* problem: XLA programs are
+shape-specialized, so a naive "pad the batch to the longest request and
+re-jit per prompt length" serving loop recompiles on every new shape and
+stalls every request behind the longest one.  This engine fixes the
+shapes once and routes all traffic through exactly two programs per
+model (the Orca/vLLM decomposition, rebuilt XLA-native on static shapes):
+
+* ``prefill(params, arena, last, tokens[1, T], length, slot, ...)`` —
+  one compiled program per **prompt-length bucket** T (powers of two up
+  to ``max_seq``), built lazily on first use and jit-cached forever
+  after.  A prompt is right-padded to its bucket, embedded through the
+  model's chunked decode path at scalar cache index 0, and its K/V rows
+  are scattered into row ``slot`` of the arena.  Pad positions write
+  garbage K/V beyond ``length`` — harmless, because a position is only
+  ever attended after the decode step that overwrites it (causal mask
+  ``<= index``, and the write at ``index`` happens before the attend in
+  the same program).  The first output token is sampled in-program from
+  the last *real* position's logits (``return_hidden`` + a dtype-matched
+  head einsum, the same never-materialize-the-[T, V]-logits discipline
+  as ``generate``).
+* ``decode(params, arena, last[B], active[B], ...)`` — ONE compiled
+  program total: every slot advances one token against its own cache
+  row at its own position (the model's vector-index cache path,
+  models/transformer.py:_decode_attend_slots).  Inactive slots compute
+  garbage that is masked out of the state (their index does not
+  advance); occupancy is a runtime *value*, never a compile shape.
+
+The **arena** is the fixed [n_slots, H, max_seq, head_dim] per-block K/V
+buffer pair plus a per-slot position vector (``cache_shapes(...,
+per_slot_index=True)``).  It is donated to both programs, so the cache
+is updated in place on device — no per-step reallocation of the largest
+buffer in serving.  Sampling knobs ride along as per-slot device arrays
+(dtdl_tpu/serve/sampling.py), so greedy and nucleus requests share the
+same compiled step.
+
+The engine is the functional core: it owns the model, the (unboxed)
+params, and the compile caches, and threads ``(arena, last_tokens)``
+state the caller owns.  Continuous batching policy — admission, slot
+lifecycle, EOS, telemetry — lives in dtdl_tpu/serve/scheduler.py.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtdl_tpu.serve.sampling import SampleParams, pack, sample
+
+
+def default_buckets(max_seq: int, start: int = 16) -> tuple[int, ...]:
+    """Power-of-two prompt buckets up to ``max_seq`` (always included):
+    each prompt pays at most 2x its own prefill FLOPs in padding, for
+    log2(max_seq) compiled prefill programs worst case."""
+    out, b = [], start
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(out)
+
+
+class InferenceEngine:
+    """Compiled prefill/decode pair over a slotted KV arena (see module
+    docstring).  ``n_slots`` is the decode batch width — the one shape
+    the decode program is specialized to."""
+
+    def __init__(self, model, params, n_slots: int = 8, buckets=None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.model = model
+        self.params = nn.unbox(params)   # plain leaves either way
+        self.n_slots = n_slots
+        self.max_seq = model.max_seq
+        self.buckets = (tuple(sorted(set(buckets))) if buckets
+                        else default_buckets(model.max_seq))
+        if self.buckets[-1] > model.max_seq:
+            raise ValueError(f"bucket {self.buckets[-1]} exceeds "
+                             f"max_seq={model.max_seq}")
+        # single-row cache template the prefill program zero-fills
+        self._cache1 = model.cache_shapes(1)
+        self._prefill_fns: dict[int, object] = {}
+        self._decode_fn = None
+
+    # ---- state the caller threads ------------------------------------
+
+    def init_arena(self):
+        """Fresh zeroed [n_slots] KV arena (donated to every program)."""
+        return self.model.init_cache(self.n_slots, per_slot_index=True)
+
+    def init_last_tokens(self):
+        """The [n_slots] last-sampled-token vector (NOT donated: the
+        scheduler's lag harvest holds references to past vectors)."""
+        return jnp.zeros((self.n_slots,), jnp.int32)
+
+    # ---- bucketing ----------------------------------------------------
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]} (max_seq={self.max_seq})")
+
+    # ---- compiled programs -------------------------------------------
+
+    def _build_prefill(self, T: int):
+        model, cache1 = self.model, self._cache1
+
+        def prefill(params, arena, last, tokens, length, slot, key,
+                    temp, top_k, top_p):
+            cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 cache1)
+            hidden, muts = model.apply(
+                {"params": params, "cache": cache}, tokens, decode=True,
+                return_hidden=True, mutable=["cache"])
+            # logits of the last REAL position only (pad rows beyond
+            # `length` never touch the head)
+            h_last = jax.lax.dynamic_slice_in_dim(
+                hidden, length - 1, 1, axis=1)[:, 0]           # [1, D]
+            logits = jnp.einsum(
+                "bd,vd->bv", h_last,
+                params["embed"].astype(model.dtype)).astype(jnp.float32)
+            tok = sample(logits, key, temp, top_k, top_p)      # [1]
+
+            def write(a, n):
+                if n.ndim == 0:   # index leaf: the true prompt length,
+                    return jax.lax.dynamic_update_slice(   # not bucket T
+                        a, length[None].astype(a.dtype), (slot,))
+                return jax.lax.dynamic_update_slice(
+                    a, n.astype(a.dtype), (slot, 0, 0, 0))
+            arena = jax.tree.map(write, arena, muts["cache"])
+            last = jax.lax.dynamic_update_slice(last, tok, (slot,))
+            return arena, last, logits[0]
+
+        return jax.jit(prefill, donate_argnums=(1,))
+
+    def _build_decode(self):
+        model = self.model
+
+        def decode(params, arena, last, active, key, temp, top_k, top_p):
+            logits, muts = model.apply(
+                {"params": params, "cache": arena}, last[:, None],
+                decode=True, mutable=["cache"])
+
+            def fix(old, new):
+                if old.ndim == 1:   # index: only active slots advance
+                    return jnp.where(active, new, old)
+                return new          # garbage K/V writes into dead slots
+            arena = jax.tree.map(fix, arena, muts["cache"])
+
+            lg = logits[:, 0].astype(jnp.float32)              # [B, V]
+            tok = sample(lg, key, temp, top_k, top_p)
+            last = jnp.where(active, tok, last)
+            return arena, last, lg
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def compile_stats(self) -> dict:
+        """Compiled-program counts — the no-per-request-recompile
+        receipt: one entry per touched prefill bucket, one decode
+        program, each with a jit cache size that must stay 1."""
+        def n(f):
+            try:
+                return f._cache_size()
+            except AttributeError:   # pragma: no cover - jax internals
+                return -1
+        return {"prefill": {T: n(f) for T, f in self._prefill_fns.items()},
+                "decode": n(self._decode_fn) if self._decode_fn else 0}
+
+    # ---- the two entry points ----------------------------------------
+
+    def prefill(self, arena, last_tokens, slot: int, prompt,
+                sampling: SampleParams = SampleParams(), key=None):
+        """Admit ``prompt`` into arena row ``slot``; returns the updated
+        ``(arena, last_tokens, logits[V])`` — ``last_tokens[slot]`` is
+        the request's first sampled token."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size > self.max_seq:
+            raise ValueError(f"prompt length {prompt.size} exceeds "
+                             f"max_seq={self.max_seq}")
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.n_slots})")
+        T = self.bucket_for(prompt.size)
+        if T not in self._prefill_fns:
+            self._prefill_fns[T] = self._build_prefill(T)
+        padded = np.zeros((1, T), np.int32)
+        padded[0, :prompt.size] = prompt
+        key = jax.random.PRNGKey(0) if key is None else key
+        arena, last, logits = self._prefill_fns[T](
+            self.params, arena, last_tokens, jnp.asarray(padded),
+            jnp.asarray(prompt.size, jnp.int32),
+            jnp.asarray(slot, jnp.int32), key, *pack([sampling]))
+        return arena, last, logits
+
+    def decode(self, arena, last_tokens, active, key, temp, top_k, top_p):
+        """One token for every active slot; ``active`` is a [n_slots]
+        bool mask (a runtime value — occupancy never recompiles).
+        Returns ``(arena, last_tokens, logits[n_slots, V])``."""
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        return self._decode_fn(self.params, arena, last_tokens,
+                               jnp.asarray(active), key, temp, top_k,
+                               top_p)

@@ -1,0 +1,108 @@
+"""Serving telemetry under the PR-1 async dispatch discipline.
+
+Nothing in here syncs the device per token.  Three kinds of signal, each
+with an honest clock:
+
+* **Dispatch-side counters** (prefills, decode steps, slot occupancy) —
+  pure host state the scheduler already knows; pushed per step into the
+  existing :class:`~dtdl_tpu.metrics.device.MetricsQueue` and drained at
+  summary, so a future device-scalar metric (e.g. an in-program
+  accept-rate) rides the same bounded-lag queue instead of growing a new
+  sync point.
+* **Harvest-side request timing** (TTFT, per-token latency) — stamped
+  when a token *reaches the host* through the scheduler's lag harvest,
+  i.e. at the first moment the serving process could actually have
+  observed it.  With ``harvest_lag=k`` these run up to k steps late;
+  ``Scheduler.drain`` settles them exactly at boundaries.
+* **Throughput** (prefill/decode tokens per second) — wall-clock between
+  the first dispatch and the last harvest, the same fetch-ends-the-
+  timed-region rule bench.py uses.
+"""
+
+from __future__ import annotations
+
+import time
+
+from dtdl_tpu.metrics.device import MetricsQueue
+
+
+class ServeMetrics:
+    """Scheduler-driven serving telemetry (see module docstring)."""
+
+    def __init__(self, queue: MetricsQueue = None, n_slots: int = 0):
+        self.queue = queue or MetricsQueue()
+        self.n_slots = n_slots
+        self.n_submitted = 0
+        self.n_admitted = 0
+        self.n_finished = 0
+        self.n_decode_steps = 0
+        self.decode_slot_steps = 0      # sum of active slots over steps
+        self.prefill_tokens = 0
+        self.ttft_s: list[float] = []
+        self.tok_latency_s: list[float] = []   # per-request mean, decode
+        self._t_start = None
+        self._t_last_harvest = None
+        self._occupancy: list[dict] = []
+
+    # ---- scheduler hooks ---------------------------------------------
+
+    def on_submit(self, req):
+        self.n_submitted += 1
+
+    def on_admit(self, req, slot: int, prompt_len: int):
+        if self._t_start is None:
+            self._t_start = time.perf_counter()
+        self.n_admitted += 1
+        self.prefill_tokens += prompt_len
+
+    def on_step(self, n_active: int, n_slots: int):
+        if n_active:
+            self.n_decode_steps += 1
+            self.decode_slot_steps += n_active
+        self.n_slots = n_slots or self.n_slots
+        # per-step entry through the bounded async queue; drained (not
+        # read inline) at summary() — host scalars today, device scalars
+        # tomorrow, same discipline either way
+        self._occupancy.extend(
+            self.queue.push({"n_active": float(n_active)}))
+
+    def on_first_token(self, req):
+        self._t_last_harvest = time.perf_counter()
+        self.ttft_s.append(self._t_last_harvest - req.t_submit)
+
+    def on_finish(self, req):
+        self._t_last_harvest = time.perf_counter()
+        self.n_finished += 1
+        n_decoded = len(req.tokens) - 1
+        if n_decoded > 0:
+            self.tok_latency_s.append(
+                (req.t_done - req.t_first) / n_decoded)
+
+    # ---- aggregation --------------------------------------------------
+
+    def summary(self) -> dict:
+        """Drain the step queue and aggregate; call after
+        ``Scheduler.drain`` (or ``run``) so harvest times are settled."""
+        self._occupancy.extend(self.queue.drain())
+        # both endpoints or no window: before the first harvest there is
+        # no honest wall-clock span to report
+        wall = 0.0
+        if self._t_start is not None and self._t_last_harvest is not None:
+            wall = self._t_last_harvest - self._t_start
+        decode_tokens = self.decode_slot_steps
+        occ = [e["n_active"] for e in self._occupancy]
+        mean = lambda xs: sum(xs) / len(xs) if xs else 0.0  # noqa: E731
+        return {
+            "requests_submitted": self.n_submitted,
+            "requests_finished": self.n_finished,
+            "prefill_tokens": self.prefill_tokens,
+            "decode_steps": self.n_decode_steps,
+            "decode_tokens": decode_tokens,
+            "wall_s": round(wall, 6),
+            "decode_tokens_per_sec": round(decode_tokens / wall, 2)
+            if wall > 0 else 0.0,
+            "ttft_s_mean": round(mean(self.ttft_s), 6),
+            "tok_latency_s_mean": round(mean(self.tok_latency_s), 6),
+            "occupancy_mean": round(
+                mean(occ) / self.n_slots if self.n_slots else 0.0, 4),
+        }

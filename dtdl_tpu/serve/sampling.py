@@ -1,0 +1,94 @@
+"""Per-slot token sampling, pure jax and fold-able into the decode program.
+
+Every knob is a **per-slot device array**, never a Python static: the
+decode program samples a continuously-batched mix of requests — one slot
+greedy, its neighbor at temperature 0.9 with top-p 0.95 — and changing a
+request's sampling config must never recompile the step
+(dtdl_tpu/serve/engine.py compiles exactly one decode program).  That
+rules out the usual static ``k`` of ``lax.top_k``; both truncations are
+implemented against the sorted logits instead (one [B, V] sort serves
+top-k and top-p), which is O(V log V) work per step — noise next to the
+forward pass, and shape-static so XLA fuses it into the decode program.
+
+Conventions (one per slot, disabled values make the op an identity):
+
+* ``temperature`` — 0 = greedy argmax of the RAW logits (exactly
+  ``jnp.argmax``, the token-identity contract tests/test_serve.py pins
+  against one-at-a-time decode); > 0 divides logits before sampling.
+* ``top_k`` — keep the k highest-logit tokens; 0 = disabled.
+* ``top_p`` — nucleus: keep the smallest prefix of the sorted
+  distribution whose mass reaches ``top_p`` (the first token always
+  survives); >= 1 = disabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleParams:
+    """One request's sampling config (host-side; the scheduler packs the
+    per-slot [B] arrays the decode program consumes)."""
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 disables), got "
+                             f"{self.top_k}")
+        if not 0 < self.top_p:
+            raise ValueError(f"top_p must be > 0, got {self.top_p}")
+
+
+GREEDY = SampleParams()
+
+
+def sample(logits, key, temperature, top_k, top_p):
+    """Sample one token per slot: [B, V] f32 logits -> [B] int32.
+
+    ``temperature``/``top_p`` are f32 [B], ``top_k`` int32 [B] — all
+    dynamic (see module docstring).  Rows whose temperature is 0 return
+    the raw argmax regardless of their top-k/top-p settings.
+    """
+    _, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)                    # [B, V] desc
+    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+
+    # top-k: threshold at the k-th sorted logit (ties widen the keep set,
+    # the standard tie behavior of threshold-based top-k)
+    kth = jnp.take_along_axis(
+        sorted_logits, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1)
+    keep_k = jnp.where((top_k > 0)[:, None], scaled >= kth, True)
+
+    # top-p over the sorted distribution: position i survives while the
+    # mass BEFORE it is < top_p, so the first token always survives and
+    # the kept prefix is the smallest one reaching top_p
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = before < top_p[:, None]
+    inv = jnp.argsort(order, axis=-1)
+    keep_p = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+
+    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    drawn = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, drawn)
+
+
+def pack(params_per_slot) -> tuple:
+    """[SampleParams, ...] -> the (temperature, top_k, top_p) device
+    vectors the engine programs take."""
+    return (jnp.asarray([p.temperature for p in params_per_slot],
+                        jnp.float32),
+            jnp.asarray([p.top_k for p in params_per_slot], jnp.int32),
+            jnp.asarray([p.top_p for p in params_per_slot], jnp.float32))

@@ -1,0 +1,235 @@
+"""Slot-based continuous batcher over the InferenceEngine.
+
+Orca-style iteration-level scheduling on fixed XLA shapes: the engine's
+decode program always steps all ``n_slots`` arena rows; this module
+decides *what occupies the rows*.  A request is admitted into the first
+free slot (one bucketed prefill), decodes in lockstep with whatever else
+is in flight, and retires the moment its budget is exhausted — freeing
+the row for the next queued request **mid-flight**, while the other
+slots keep decoding.  Short requests never wait for long ones and the
+batch never pads to the longest request; the only granularity is one
+decode step.
+
+Dispatch discipline (PR 1, SCALING.md "Async dispatch discipline"): the
+loop never reads a device value it just dispatched.  The decode feedback
+path — sampled token back in as next input — stays ON DEVICE via the
+``last_tokens`` vector, so back-to-back steps pipeline without any
+host↔device round-trip.  Host-side bookkeeping uses only what the host
+already knows at dispatch time (slot occupancy, per-request token
+budgets).  Sampled tokens reach the host through a **lag harvest**: each
+step's token vector enters a bounded queue and is converted
+``harvest_lag`` steps later, when the device has long finished (the same
+backpressure shape as metrics.MetricsQueue).  The one consequence: EOS
+detection is late by up to ``harvest_lag`` steps, so a slot decodes up
+to that many garbage tokens past its stop token before retiring — they
+are trimmed from the output at harvest.  ``harvest_lag=0`` restores
+sync-every-step EOS exactness at sync-every-step cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from dtdl_tpu.serve.engine import InferenceEngine
+from dtdl_tpu.serve.metrics import ServeMetrics
+from dtdl_tpu.serve.sampling import GREEDY, SampleParams
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its lifecycle record.
+
+    ``tokens`` fills with the generated tokens (eos included, post-eos
+    trimmed) as they harvest; ``done`` flips when the last one lands.
+    """
+    prompt: Sequence[int]
+    max_new_tokens: int
+    sampling: SampleParams = GREEDY
+    eos_id: Optional[int] = None
+    rid: int = dataclasses.field(default_factory=lambda: next(_ids))
+    tokens: list = dataclasses.field(default_factory=list)
+    done: bool = False
+    # wall-clock lifecycle (host side; first/done are harvest times, i.e.
+    # when the host could actually observe the token)
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    t_done: float = 0.0
+    admit_step: int = -1
+    # internal: tokens dispatched / slot retired (budget exhausted)
+    _dispatched: int = dataclasses.field(default=0, repr=False)
+    _retired: bool = dataclasses.field(default=False, repr=False)
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{self.max_new_tokens}")
+
+
+class Scheduler:
+    """Continuous batcher (see module docstring).
+
+    ``submit`` enqueues; ``step`` runs one admit+decode round; ``run``
+    drives until everything submitted has finished and returns the
+    finished requests in completion order.
+    """
+
+    def __init__(self, engine: InferenceEngine, seed: int = 0,
+                 harvest_lag: int = 4, metrics: ServeMetrics = None):
+        if harvest_lag < 0:
+            raise ValueError(f"harvest_lag must be >= 0, got "
+                             f"{harvest_lag}")
+        self.engine = engine
+        self.arena = engine.init_arena()
+        self.last_tokens = engine.init_last_tokens()
+        self.queue: deque[Request] = deque()
+        self.slots: list[Optional[Request]] = [None] * engine.n_slots
+        self.harvest_lag = harvest_lag
+        self.metrics = metrics or ServeMetrics(n_slots=engine.n_slots)
+        self.finished: list[Request] = []
+        self._reqs: dict[int, Request] = {}
+        self._active = np.zeros(engine.n_slots, bool)
+        self._temp = np.zeros(engine.n_slots, np.float32)
+        self._topk = np.zeros(engine.n_slots, np.int32)
+        self._topp = np.ones(engine.n_slots, np.float32)
+        self._key = jax.random.PRNGKey(seed)
+        # lag harvest: (token_vector_device, ((slot, rid, gen_idx), ...))
+        self._pending: deque[tuple[Any, tuple]] = deque()
+        self.step_count = 0
+
+    # ---- intake -------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        # full admission validation HERE: a bad request rejected at
+        # admit time would already be popped from the queue and would
+        # strand every other in-flight request mid-run
+        prompt_len = len(req.prompt)
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if prompt_len > self.engine.buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the largest "
+                f"prefill bucket {self.engine.buckets[-1]} "
+                f"(max_seq={self.engine.max_seq})")
+        req.t_submit = time.perf_counter()
+        self._reqs[req.rid] = req
+        self.queue.append(req)
+        self.metrics.on_submit(req)
+        return req
+
+    # ---- slot lifecycle ----------------------------------------------
+
+    def _budget(self, req: Request) -> int:
+        # the k-th decode step writes K/V at position len(prompt)+k-1,
+        # which must stay < max_seq; prefill contributes token 1 for free
+        return min(req.max_new_tokens,
+                   self.engine.max_seq - len(req.prompt) + 1)
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _retire(self, slot: int):
+        req = self.slots[slot]
+        req._retired = True
+        self.slots[slot] = None
+        self._active[slot] = False
+
+    def _admit(self):
+        for slot in range(self.engine.n_slots):
+            if self.slots[slot] is not None or not self.queue:
+                continue
+            req = self.queue.popleft()
+            sp = req.sampling
+            self.arena, self.last_tokens, _ = self.engine.prefill(
+                self.arena, self.last_tokens, slot, req.prompt, sp,
+                self._next_key())
+            self.slots[slot] = req
+            self._active[slot] = True
+            self._temp[slot] = sp.temperature
+            self._topk[slot] = sp.top_k
+            self._topp[slot] = sp.top_p
+            req.t_admit = time.perf_counter()
+            req.admit_step = self.step_count
+            req._dispatched = 1
+            self._pending.append(
+                (self.last_tokens, ((slot, req.rid, 0),)))
+            self.metrics.on_admit(req, slot, len(req.prompt))
+            if req._dispatched >= self._budget(req):
+                self._retire(slot)
+
+    # ---- the decode round --------------------------------------------
+
+    def step(self) -> int:
+        """One admit + decode round; returns how many slots decoded."""
+        self._admit()
+        n_active = int(self._active.sum())
+        if n_active:
+            entries = []
+            for slot, req in enumerate(self.slots):
+                if self._active[slot]:
+                    entries.append((slot, req.rid, req._dispatched))
+            self.arena, self.last_tokens, _ = self.engine.decode(
+                self.arena, self.last_tokens, self._active,
+                self._next_key(), self._temp, self._topk, self._topp)
+            self._pending.append((self.last_tokens, tuple(entries)))
+            for slot, req in enumerate(self.slots):
+                if self._active[slot]:
+                    req._dispatched += 1
+                    if req._dispatched >= self._budget(req):
+                        self._retire(slot)
+        self.step_count += 1
+        self.metrics.on_step(n_active, self.engine.n_slots)
+        while len(self._pending) > self.harvest_lag:
+            self._harvest_one()
+        return n_active
+
+    # ---- harvest ------------------------------------------------------
+
+    def _harvest_one(self):
+        vec, entries = self._pending.popleft()
+        arr = np.asarray(vec)   # blocks only until THIS (lagged) step
+        now = time.perf_counter()
+        for slot, rid, gen_idx in entries:
+            req = self._reqs[rid]
+            if req.done:         # post-eos garbage from the lag window
+                continue
+            req.tokens.append(int(arr[slot]))
+            if gen_idx == 0:
+                req.t_first = now
+                self.metrics.on_first_token(req)
+            hit_eos = (req.eos_id is not None
+                       and req.tokens[-1] == req.eos_id)
+            if hit_eos and self.slots[slot] is req:
+                # EOS observed `lag` steps after dispatch: stop decoding
+                self._retire(slot)
+            if hit_eos or (req._retired
+                           and len(req.tokens) >= req._dispatched):
+                req.done = True
+                req.t_done = now
+                self.finished.append(req)
+                self.metrics.on_finish(req)
+
+    def drain(self):
+        """Harvest everything still in flight (the boundary sync)."""
+        while self._pending:
+            self._harvest_one()
+
+    # ---- driver -------------------------------------------------------
+
+    def run(self, requests: Sequence[Request] = ()) -> list[Request]:
+        for r in requests:
+            self.submit(r)
+        while self.queue or any(s is not None for s in self.slots):
+            self.step()
+        self.drain()
+        return self.finished

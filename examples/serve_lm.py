@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Continuous-batching LM serving — the request path of the north star.
+
+The reference repos train and stop; this example closes the loop the
+ROADMAP asks for ("serves heavy traffic"): a TransformerLM — freshly
+initialized, restored from a training snapshot, or bridged from a
+4D-megatron run — behind the dtdl_tpu.serve engine+scheduler.  Mixed
+prompt lengths and mixed sampling configs share one fixed-shape decode
+program; requests are admitted into KV-arena slots the moment one frees.
+
+    python examples/serve_lm.py                       # synthetic traffic
+    python examples/serve_lm.py --n-requests 32 --n-slots 8 \
+        --temperature 0.8 --top-p 0.95
+    python examples/serve_lm.py --restore ckpt.msgpack --model-size small
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from common import bootstrap
+from dtdl_tpu.models import transformer_lm
+from dtdl_tpu.serve import InferenceEngine, Request, SampleParams, Scheduler
+from dtdl_tpu.utils import seed_everything
+from dtdl_tpu.utils.config import flag, make_parser
+
+
+def main():
+    parser = make_parser("dtdl_tpu: batched LM serving")
+    flag(parser, "--model-size", default="tiny",
+         choices=["tiny", "small", "base"])
+    flag(parser, "--restore", default="",
+         help="msgpack weights to serve (default: random init)")
+    flag(parser, "--n-slots", type=int, default=4,
+         help="decode batch width (KV-arena rows)")
+    flag(parser, "--n-requests", type=int, default=12)
+    flag(parser, "--max-new-tokens", type=int, default=24)
+    flag(parser, "--temperature", type=float, default=0.0,
+         help="0 = greedy")
+    flag(parser, "--top-k", type=int, default=0, help="0 = disabled")
+    flag(parser, "--top-p", type=float, default=1.0, help="1 = disabled")
+    flag(parser, "--harvest-lag", type=int, default=4,
+         help="steps a sampled token may stay device-side before the "
+              "host reads it (0 = sync every step)")
+    flag(parser, "--seed", type=int, default=0)
+    args = parser.parse_args()
+    bootstrap(args)
+    seed_everything(args.seed)
+
+    model = transformer_lm(args.model_size, attn_impl="dense",
+                           dtype=jnp.float32)
+    example = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(args.seed), example)["params"]
+    import flax.linen as nn
+    params = nn.unbox(params)
+    if args.restore:
+        from dtdl_tpu.ckpt import load_weights
+        params = load_weights(args.restore, params)
+
+    engine = InferenceEngine(model, params, n_slots=args.n_slots)
+    sched = Scheduler(engine, seed=args.seed,
+                      harvest_lag=args.harvest_lag)
+    sp = SampleParams(temperature=args.temperature, top_k=args.top_k,
+                      top_p=args.top_p)
+
+    # synthetic traffic: mixed prompt lengths, one shared sampling config
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(4, min(64, model.max_seq // 2),
+                        args.n_requests)
+    reqs = [Request(rng.integers(0, model.vocab_size, n).tolist(),
+                    args.max_new_tokens, sampling=sp) for n in lens]
+
+    t0 = time.perf_counter()
+    sched.run(reqs)
+    dt = time.perf_counter() - t0
+    for r in reqs[:4]:
+        print(f"  req {r.rid}: prompt[{len(r.prompt)}] -> "
+              f"{r.tokens[:12]}{'...' if len(r.tokens) > 12 else ''}")
+    s = sched.metrics.summary()
+    print(f"served {s['requests_finished']} requests in {dt:.2f}s  "
+          f"(decode {s['decode_tokens_per_sec']} tok/s, occupancy "
+          f"{s['occupancy_mean']:.0%}, ttft {s['ttft_s_mean'] * 1e3:.1f}ms)")
+    print("compiled programs:", engine.compile_stats())
+
+
+if __name__ == "__main__":
+    main()

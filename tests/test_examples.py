@@ -315,3 +315,15 @@ def test_train_lm_4d_checkpoint_resume(tmp_path):
     m_res = re.search(r"final loss ([\d.]+)", resumed)
     assert m_full and m_res, (full, resumed)
     assert m_full.group(1) == m_res.group(1), (full, resumed)
+
+
+@pytest.mark.slow
+def test_serve_lm_example():
+    """Serving example end-to-end: continuous batching over synthetic
+    traffic, compile counts stay bucketed (compile-heavy -> slow; the
+    fast tier-1 serving coverage lives in tests/test_serve.py)."""
+    out = run_example(
+        "serve_lm.py", "--n-requests", "5", "--n-slots", "2",
+        "--max-new-tokens", "6", "--harvest-lag", "2")
+    assert re.search(r"served 5 requests", out), out
+    assert "'decode': 1" in out, out
