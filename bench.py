@@ -47,31 +47,14 @@ import optax
 
 BASELINE_SAMPLES_PER_SEC = 64 / 0.255  # reference pytorch/README.md:41 (P100)
 
-# Dense bf16 peak FLOP/s per chip, by device_kind substring (longest match
-# wins, so "TPU v5 lite" beats "TPU v5").  Public figures: v2 45T, v3 123T,
-# v4 275T, v5e 197T, v5p 459T, v6e (Trillium) 918T.
-_PEAK_BF16 = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-    "TPU v6": 918e12,
-}
+# chip peaks + analytic LM FLOPs live in the obs subsystem now (PR 3);
+# bench.py re-exports the old names so scripts/lm_sweep.py et al. keep
+# importing `from bench import lm_analytic_flops, peak_flops_per_chip`
+from dtdl_tpu.obs.goodput import (  # noqa: E402
+    _PEAK_BF16, lm_train_flops, peak_flops_per_chip,
+)
 
-
-def peak_flops_per_chip() -> float | None:
-    """bf16 peak for the local chip, or None if unknown (e.g. CPU)."""
-    kind = getattr(jax.devices()[0], "device_kind", "") or ""
-    best = None
-    for k, v in _PEAK_BF16.items():
-        if k in kind and (best is None or len(k) > len(best[0])):
-            best = (k, v)
-    return best[1] if best else None
+lm_analytic_flops = lm_train_flops
 
 
 def _flops_of(compiled) -> float | None:
@@ -149,36 +132,6 @@ def bench_one(model_name: str, batch_size: int, warmup: int = 10,
         if peak:
             row["mfu"] = round(achieved / peak, 4)
     return row
-
-
-def lm_analytic_flops(cfg, batch: int, seq: int) -> float:
-    """Matmul-only model FLOPs for one LM train step (fwd + 2x bwd).
-
-    XLA's ``cost_analysis()`` cannot see inside Pallas custom-calls, so it
-    misses the flash-attention FLOPs entirely (measured on 'base'
-    bs=8/seq=4096: 8.3e12 reported vs 11.6e12 analytic — the 3.2e12 gap is
-    exactly the attention matmuls; see LM_ROOFLINE.md).  The analytic count
-    is the honest MFU numerator.  Causal attention is counted at the
-    *computed half* (the kernel skips above-diagonal tiles) — conservative
-    vs quoting dense S^2 work — and the backward pass is counted at 2x
-    forward (the standard model-FLOPs convention; the kernel's recompute
-    overhead is deliberately NOT credited)."""
-    t = seq - 1
-    qkvo = 4 * 2 * batch * t * cfg.d_model * (cfg.n_heads * cfg.head_dim)
-    attn = 2 * 2 * batch * cfg.n_heads * t * t * cfg.head_dim * 0.5
-    mlp = 3 * 2 * batch * t * cfg.d_model * cfg.d_ff
-    head = 2 * batch * t * cfg.d_model * cfg.vocab_size
-    n_moe = 0
-    if getattr(cfg, "n_experts", 0) and hasattr(cfg, "moe_every"):
-        # MoE layers (every moe_every-th, TransformerLM's rule) count
-        # ACTIVATED expert compute (top_k x the dense MLP — the standard
-        # MoE model-FLOPs convention); the router, dispatch/combine
-        # einsums, and capacity over-provisioning (cf > 1 executes more)
-        # are deliberately not credited
-        n_moe = cfg.n_layers // cfg.moe_every
-    fwd = (cfg.n_layers * (qkvo + attn) + (cfg.n_layers - n_moe) * mlp
-           + n_moe * getattr(cfg, "moe_top_k", 1) * mlp + head)
-    return 3.0 * fwd
 
 
 def bench_lm(batch_size: int = 8, seq: int = 4096, size: str = "base",
@@ -313,6 +266,72 @@ def bench_host_overhead(steps: int = 192, batch_size: int = 64,
     row[f"unroll{unroll}_speedup_vs_sync"] = round(
         rates[f"unroll{unroll}"] / rates["sync"], 3)
     return row
+
+
+def bench_observability(steps: int = 192, batch_size: int = 64,
+                        log_interval: int = 24) -> dict:
+    """Observability overhead receipt: the SAME async ``train_epoch``
+    with the obs layer off vs fully on (tracer + recompile sentinel +
+    goodput meter).
+
+    Uses the host-overhead harness's deliberately tiny model so the
+    host-side loop dominates — the worst case for per-step span/sentinel
+    bookkeeping.  The contract (ISSUE 3): ``overhead_frac`` (1 -
+    on/off steps/sec) stays under 2%; anything more means a span or
+    sentinel snuck device work or allocation into the hot path.
+    """
+    from dtdl_tpu.data.loader import DataLoader
+    from dtdl_tpu.models import MLP
+    from dtdl_tpu.obs import GoodputMeter, Observer
+    from dtdl_tpu.parallel.strategy import SingleDevice
+    from dtdl_tpu.train import init_state, make_train_step, train_epoch
+
+    strategy = SingleDevice()
+    rng = np.random.default_rng(0)
+    n = steps * batch_size
+    x = rng.normal(size=(n, 64)).astype(np.float32)
+    y = rng.integers(0, 10, n).astype(np.int64)
+    loader = DataLoader({"image": x, "label": y}, batch_size, shuffle=False)
+    tx = optax.sgd(0.01)
+    step = make_train_step(strategy)
+
+    def fresh_state():
+        return strategy.replicate(init_state(
+            MLP(n_units=64), jax.random.PRNGKey(0),
+            jnp.zeros((1, 64)), tx))
+
+    def run(observer):
+        state = fresh_state()
+        # epoch 0 = warmup (compile); epoch 1 = timed
+        state, _ = train_epoch(step, state, loader, strategy,
+                               log_interval=log_interval,
+                               observer=observer)
+        if observer is not None:
+            # drop the warmup windows: the compile stall would otherwise
+            # BE the reported step-time p99
+            from dtdl_tpu.obs import LogHistogram
+            observer.step_time_s = LogHistogram()
+        t0 = time.perf_counter()
+        state, means = train_epoch(step, state, loader, strategy,
+                                   log_interval=log_interval,
+                                   observer=observer)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(means["loss"])
+        return steps / dt
+
+    off = run(None)
+    obs = Observer(trace=True, sentinel="warn",
+                   goodput=GoodputMeter(samples_per_step=batch_size))
+    on = run(obs)
+    return {"model": "observability", "batch_size": batch_size,
+            "steps": steps, "log_interval": log_interval,
+            "off_steps_per_sec": round(off, 1),
+            "on_steps_per_sec": round(on, 1),
+            "overhead_frac": round(1.0 - on / off, 4),
+            "trace_events": len(obs.tracer),
+            "recompile_events": len(obs.sentinel.events),
+            "step_time_p99_ms": round(
+                obs.step_time_s.p99 * 1e3, 3)}
 
 
 def bench_serving(size: str = None, slot_sweep=(1, 4, 8),
@@ -662,6 +681,9 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-serving", action="store_true",
                    help="skip the serving (prefill/decode tokens/sec vs "
                         "batch size) row")
+    p.add_argument("--skip-observability", action="store_true",
+                   help="skip the observability-overhead (tracer on vs "
+                        "off steps/sec) row")
     p.add_argument("--serve-size", default=None,
                    help="LM size for the serving row (default: tiny on "
                         "CPU, base on an accelerator)")
@@ -728,6 +750,20 @@ def main(argv=None) -> dict:
                         "error": f"{type(e).__name__}: {e}"[:200]}
         records.append(host_row)
         print("  " + json.dumps(host_row), file=sys.stderr, flush=True)
+
+    obs_row = None
+    if not a.skip_observability:
+        # observability-overhead receipt: tracer+sentinel+goodput on vs
+        # off through the same async train_epoch (<2% contract, ISSUE 3)
+        try:
+            obs_row = bench_observability(
+                steps=max(48, a.sample_budget // 64) if a.sample_budget
+                else 192)
+        except Exception as e:   # the obs row must never sink the bench
+            obs_row = {"model": "observability",
+                       "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(obs_row)
+        print("  " + json.dumps(obs_row), file=sys.stderr, flush=True)
 
     serve_row = None
     if not a.skip_serving:
@@ -804,6 +840,8 @@ def main(argv=None) -> dict:
     if host_row and "async_speedup_vs_sync" in host_row:
         summary["host_overhead_async_speedup"] = \
             host_row["async_speedup_vs_sync"]
+    if obs_row and "overhead_frac" in obs_row:
+        summary["observability_overhead_frac"] = obs_row["overhead_frac"]
     if serve_row and serve_row.get("sweep"):
         best_d = max(serve_row["sweep"],
                      key=lambda s: s["decode_tokens_per_sec"])

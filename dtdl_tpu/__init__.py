@@ -23,6 +23,10 @@ train     jitted step engines and five API flavors: imperative loop,
           Keras fit(), Chainer Trainer, TF1 Estimator, Caffe Solver
 ckpt      leader-gated checkpointing (weights / per-epoch / full state)
 metrics   metrics bus (stdout / JSONL / TensorBoard sinks)
+obs       observability: span tracer (Chrome trace / Perfetto export),
+          recompile sentinel, goodput/MFU accounting, streaming
+          latency-percentile histograms — one Observer facade that
+          every loop flavor and the serve scheduler accept
 launch    local, TPU-VM slice, and SLURM launchers (fail-fast +
           checkpoint-restart elasticity)
 utils     flags, seeding, timing, profiling, prototxt parsing
@@ -36,3 +40,4 @@ _compat.install()   # jax.shard_map / lax.pcast / jax.typeof on legacy jax
 
 from dtdl_tpu.runtime.mesh import build_mesh, hybrid_mesh, local_mesh  # noqa: F401
 from dtdl_tpu.runtime.bootstrap import initialize, is_leader  # noqa: F401
+from dtdl_tpu.obs import Observer  # noqa: F401

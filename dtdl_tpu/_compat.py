@@ -41,6 +41,23 @@ import jax.lax
 SHIMMED = False
 
 
+try:   # every jax this repo supports ships TraceAnnotation, but the obs
+    # layer must degrade to pure host tracing rather than hard-dep on it
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:   # pragma: no cover - profiler-less jax build
+    _TraceAnnotation = None
+
+
+def trace_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation(name)`` when this jax has one,
+    else ``None`` — so obs spans show up inside an active jax.profiler
+    capture without making the profiler a dependency.  The annotation is
+    a TraceMe: ~ns overhead while no capture is running."""
+    if _TraceAnnotation is None:
+        return None
+    return _TraceAnnotation(name)
+
+
 class _AvalView:
     """Proxy of an abstract value that answers ``.vma`` on legacy jax."""
 

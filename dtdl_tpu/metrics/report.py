@@ -40,7 +40,20 @@ class Accumulator:
         self._counts.clear()
 
 
-class StdoutSink:
+class _SinkContext:
+    """Context-manager mixin: ``with JsonlSink(...) as s:`` closes (and
+    therefore flushes) on ANY exit, including exceptions — a crashed run
+    must not lose its buffered log tail."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class StdoutSink(_SinkContext):
     """Fixed-format prints matching the reference's per-batch log line
     (loss / acc / batch time, reference pytorch/distributed_data_parallel.py:144-148)."""
 
@@ -69,7 +82,7 @@ class StdoutSink:
         pass
 
 
-class JsonlSink:
+class JsonlSink(_SinkContext):
     """JSON-lines log file (Chainer ``LogReport`` parity — the reference
     writes a JSON log under the trainer out dir, chainer/train_mnist.py:103)."""
 
@@ -88,7 +101,13 @@ class JsonlSink:
         self._f.close()
 
 
-class TensorBoardSink:
+# module-level so the no-writer warning really fires once per process,
+# not once per TensorBoardSink instantiation (fit() creates one per
+# TensorBoard callback; a sweep would previously spam the log)
+_TB_WARNED = False
+
+
+class TensorBoardSink(_SinkContext):
     """TensorBoard event files when a writer implementation is importable.
 
     TF2-track parity (reference tensorflow2/mnist_single.py:72-76).  Degrades
@@ -107,10 +126,14 @@ class TensorBoardSink:
                 from tensorboardX import SummaryWriter  # type: ignore
                 self._writer = SummaryWriter(logdir)
             except Exception:
-                import logging
-                logging.getLogger("dtdl_tpu").warning(
-                    "no tensorboard writer available; TensorBoardSink is a "
-                    "no-op (metrics still go to stdout/JSONL sinks)")
+                global _TB_WARNED
+                if not _TB_WARNED:
+                    _TB_WARNED = True
+                    import logging
+                    logging.getLogger("dtdl_tpu").warning(
+                        "no tensorboard writer available; TensorBoardSink "
+                        "is a no-op (metrics still go to stdout/JSONL "
+                        "sinks)")
 
     def write(self, payload: dict) -> None:
         if self._writer is None:
@@ -127,11 +150,23 @@ class TensorBoardSink:
 
 
 class Reporter:
-    """Fan-out of metric payloads to sinks; silent on non-leader processes."""
+    """Fan-out of metric payloads to sinks; silent on non-leader processes.
+
+    A Reporter is a context manager: ``with Reporter([JsonlSink(p)]) as
+    rep:`` guarantees every sink is closed/flushed on exit — exceptions
+    included — so file sinks never lose their tail to a crashed run.
+    """
 
     def __init__(self, sinks=None, leader_only: bool = True):
         self.sinks = list(sinks) if sinks is not None else [StdoutSink()]
         self.leader_only = leader_only
+
+    def __enter__(self) -> "Reporter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     @property
     def active(self) -> bool:

@@ -1,0 +1,150 @@
+"""Streaming log-bucketed histogram: tail percentiles in fixed memory.
+
+Serving tails (TTFT p99, per-token p99) and step-time tails are the
+production numbers the ROADMAP's north star is judged on, but the PR-1/
+PR-2 discipline forbids the easy implementation: appending every sample
+to a list grows without bound under heavy traffic, and computing exact
+percentiles at summary time sorts millions of floats.  This histogram
+is the standard fix (HdrHistogram / Prometheus-style): geometric
+buckets, O(1) ``add`` with no allocation, percentiles by cumulative
+walk, bounded relative error of one bucket ratio
+(``10 ** (1 / bins_per_decade)`` = 3.7% bucket width at the default 64
+bins/decade, ≤1.8% from the reported geometric midpoint).
+
+Everything is plain host floats: ``add`` never touches a device value,
+so wiring this into the serve harvest or a training drain adds zero
+syncs (the numbers it sees are already lag-harvested by the queue).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class LogHistogram:
+    """Fixed-memory log-bucketed histogram over (0, +inf).
+
+    ``lo``/``hi`` bound the bucketed range — samples outside clamp into
+    the first/last bucket but min/max/mean stay exact, so a clamped p99
+    is still never reported beyond the observed extremes.  Defaults
+    cover 1 microsecond to 1000 seconds, the whole latency range a
+    training step or a serve token can plausibly occupy.
+    """
+
+    __slots__ = ("lo", "hi", "bins_per_decade", "_ratio_log", "_n_bins",
+                 "_counts", "n", "total", "_min", "_max")
+
+    def __init__(self, lo: float = 1e-6, hi: float = 1e3,
+                 bins_per_decade: int = 64):
+        if not (0 < lo < hi):
+            raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
+        if bins_per_decade < 1:
+            raise ValueError(f"bins_per_decade must be >= 1, got "
+                             f"{bins_per_decade}")
+        self.lo = lo
+        self.hi = hi
+        self.bins_per_decade = bins_per_decade
+        self._ratio_log = 1.0 / bins_per_decade          # log10 per bucket
+        self._n_bins = int(math.ceil(
+            (math.log10(hi) - math.log10(lo)) * bins_per_decade)) + 1
+        self._counts = [0] * self._n_bins
+        self.n = 0
+        self.total = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    # ---- ingest -------------------------------------------------------
+
+    def _index(self, x: float) -> int:
+        if x <= self.lo:
+            return 0
+        i = int((math.log10(x) - math.log10(self.lo)) / self._ratio_log)
+        return min(i, self._n_bins - 1)
+
+    def add(self, x: float) -> None:
+        """O(1), allocation-free; non-positive samples clamp to ``lo``."""
+        x = float(x)
+        self._counts[self._index(x)] += 1
+        self.n += 1
+        self.total += x
+        if x < self._min:
+            self._min = x
+        if x > self._max:
+            self._max = x
+
+    def extend(self, xs) -> None:
+        for x in xs:
+            self.add(x)
+
+    def merge(self, other: "LogHistogram") -> "LogHistogram":
+        """In-place merge of an identically-bucketed histogram."""
+        if (other.lo, other.hi, other.bins_per_decade) != (
+                self.lo, self.hi, self.bins_per_decade):
+            raise ValueError("cannot merge histograms with different "
+                             "bucket layouts")
+        for i, c in enumerate(other._counts):
+            self._counts[i] += c
+        self.n += other.n
+        self.total += other.total
+        self._min = min(self._min, other._min)
+        self._max = max(self._max, other._max)
+        return self
+
+    # ---- read ---------------------------------------------------------
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+    @property
+    def min(self) -> float:
+        return self._min if self.n else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self.n else 0.0
+
+    def percentile(self, p: float) -> float:
+        """The p-th percentile (p in [0, 100]); the bucket's geometric
+        midpoint, clamped to the observed min/max so the extremes are
+        exact whatever the bucket width."""
+        if not 0 <= p <= 100:
+            raise ValueError(f"percentile must be in [0, 100], got {p}")
+        if self.n == 0:
+            return 0.0
+        rank = p / 100.0 * self.n
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank and c:
+                lo_edge = self.lo * 10 ** (i * self._ratio_log)
+                hi_edge = lo_edge * 10 ** self._ratio_log
+                mid = math.sqrt(lo_edge * hi_edge)
+                return min(max(mid, self._min), self._max)
+        return self._max          # pragma: no cover - rank <= n always hits
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50)
+
+    @property
+    def p95(self) -> float:
+        return self.percentile(95)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(99)
+
+    def summary(self, prefix: str = "", unit: float = 1.0,
+                digits: int = 6) -> dict:
+        """Flat dict of the standard fields (``unit`` rescales, e.g.
+        1e3 for ms); empty when nothing was recorded."""
+        if self.n == 0:
+            return {}
+        r = lambda v: round(v * unit, digits)  # noqa: E731
+        return {f"{prefix}count": self.n,
+                f"{prefix}mean": r(self.mean),
+                f"{prefix}p50": r(self.p50),
+                f"{prefix}p95": r(self.p95),
+                f"{prefix}p99": r(self.p99),
+                f"{prefix}max": r(self.max)}

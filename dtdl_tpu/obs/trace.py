@@ -1,0 +1,259 @@
+"""Span tracer: host-side phase timing as Chrome trace events.
+
+The PR-1 async discipline made the loops opaque on purpose — between
+boundaries the host only enqueues work, so wall-clock prints no longer
+say where host time goes (data? dispatch? the drain?).  This tracer is
+the host-side complement of ``jax.profiler`` (which sees the *device*
+ops): lightweight ``span("data") / span("dispatch") / span("drain")``
+context managers record complete ('X') events on the calling thread,
+thread-safe for the serve scheduler, exported as Chrome-trace-event JSON
+that Perfetto / ``chrome://tracing`` loads directly — the same format
+the XLA profiler emits, so the two traces read with the same tools
+(:func:`xla_events` below parses either).
+
+Two honesty rules, inherited from SCALING.md "Async dispatch
+discipline":
+
+* a span measures **host phases only** — entering/leaving a span never
+  touches the device, so tracing cannot add a sync (pinned by the
+  sync-counting test in tests/test_obs.py);
+* device time appears only as **window-settled** spans
+  (:meth:`Tracer.device_window`): once a drain has settled a log window,
+  the window's wall time is recorded on a synthetic "device" track —
+  late by one window, exact in total, never a per-step round-trip.
+
+When a ``jax.profiler`` capture is active, each span also opens a
+``TraceAnnotation`` (via :mod:`dtdl_tpu._compat` — never a hard dep) so
+host phases line up with XLA ops inside one Perfetto view.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import json
+import os
+import threading
+import time
+
+from dtdl_tpu import _compat
+
+# synthetic track ids inside the exported trace: host spans carry the
+# real thread id; settled device windows live on their own track
+DEVICE_TID = 1
+
+
+class _Span:
+    """One open span; records the 'X' event on exit."""
+
+    __slots__ = ("tracer", "name", "args", "t0", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+        self._ann = None
+
+    def __enter__(self):
+        self._ann = _compat.trace_annotation(self.name)
+        if self._ann is not None:
+            self._ann.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self.tracer._record(self.name, self.t0, t1 - self.t0,
+                            threading.get_ident(), self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder with Chrome-trace-event export.
+
+    ``max_events`` bounds memory: the buffer is a ring in spirit — once
+    full, new events are dropped and ``dropped`` counts them (a trace
+    that silently ate the heap would violate the observability budget
+    it exists to enforce).
+    """
+
+    def __init__(self, max_events: int = 200_000):
+        self.max_events = max_events
+        self.dropped = 0
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._meta: dict = {"pid": os.getpid()}
+
+    # ---- recording ----------------------------------------------------
+
+    def span(self, name: str, **args) -> _Span:
+        """Context manager timing one host phase on the calling thread."""
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker event."""
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append({
+                "name": name, "ph": "i", "s": "t",
+                "ts": (time.perf_counter() - self._t0) * 1e6,
+                "pid": self._meta["pid"],
+                "tid": threading.get_ident(),
+                **({"args": args} if args else {})})
+
+    def counter(self, name: str, value: float) -> None:
+        """A counter sample (Perfetto renders these as a line track)."""
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append({
+                "name": name, "ph": "C",
+                "ts": (time.perf_counter() - self._t0) * 1e6,
+                "pid": self._meta["pid"], "tid": 0,
+                "args": {"value": value}})
+
+    def device_window(self, name: str, seconds: float, steps: int = 1,
+                      **args) -> None:
+        """Record a window-settled device span ending *now*.
+
+        Called right after a boundary drain/sync: the window's wall time
+        is attributed to the synthetic device track, one span per
+        window (NOT per step — per-step device times do not exist
+        without per-step syncs, and we refuse to add those).
+        """
+        t1 = time.perf_counter()
+        self._record(name, t1 - seconds, seconds, DEVICE_TID,
+                     {"steps": steps, **args})
+
+    def _record(self, name: str, t0: float, dur: float, tid: int,
+                args: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            ev = {"name": name, "ph": "X",
+                  "ts": (t0 - self._t0) * 1e6, "dur": dur * 1e6,
+                  "pid": self._meta["pid"], "tid": tid}
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
+
+    # ---- export -------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def to_chrome(self) -> dict:
+        """The Chrome trace-event JSON object (Perfetto-loadable)."""
+        with self._lock:
+            events = list(self._events)
+        meta = [{"name": "thread_name", "ph": "M", "pid": self._meta["pid"],
+                 "tid": DEVICE_TID,
+                 "args": {"name": "device (window-settled)"}}]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self.dropped}}
+
+    def save(self, path: str) -> str:
+        """Write the trace to ``path`` (gzipped when it ends in .gz)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wt") as f:
+            json.dump(self.to_chrome(), f)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# jax.profiler trace parsing (folded in from scripts/trace_utils.py — the
+# script path re-exports these, so existing `from trace_utils import ...`
+# callers keep working)
+# ---------------------------------------------------------------------------
+
+# On this backend the XLA op events live at pid 3 / tid 3; each carries
+# ``hlo_category`` and ``bytes_accessed`` in its args.
+XLA_PID = XLA_TID = 3
+
+
+def xla_events(trace_dir: str) -> list:
+    """XLA op events of the newest jax.profiler trace under ``trace_dir``.
+
+    The tensorboard_plugin_profile converter is incompatible with this
+    box's TF, so the raw Chrome-trace JSON is parsed directly.
+    """
+    import glob
+    path = sorted(glob.glob(
+        trace_dir + "/plugins/profile/*/*.trace.json.gz"))[-1]
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    return [e for e in trace["traceEvents"]
+            if e.get("ph") == "X" and e.get("pid") == XLA_PID
+            and e.get("tid") == XLA_TID]
+
+
+def aggregate(events, key_fn):
+    """Sum durations/calls/bytes of ``events`` grouped by ``key_fn``.
+
+    Returns (groups, total_s): groups maps key -> [dur_s, calls,
+    hlo_category, bytes_accessed], sorted by descending time.
+    """
+    import collections
+    groups = collections.defaultdict(lambda: [0.0, 0, "", 0.0])
+    total = 0.0
+    for e in events:
+        dur = e.get("dur", 0) / 1e6          # us -> s
+        total += dur
+        args = e.get("args", {})
+        rec = groups[key_fn(e, args)]
+        rec[0] += dur
+        rec[1] += 1
+        rec[2] = args.get("hlo_category", rec[2])
+        try:
+            rec[3] += float(args.get("bytes_accessed", 0) or 0)
+        except (TypeError, ValueError):
+            pass
+    ordered = dict(sorted(groups.items(), key=lambda kv: -kv[1][0]))
+    return ordered, total
+
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+class NullTracer:
+    """Disabled tracer: every operation is a near-zero no-op (a shared
+    nullcontext for spans), so call sites never branch on 'is tracing
+    on' themselves."""
+
+    dropped = 0
+
+    def span(self, name: str, **args):
+        return _NULL_CTX
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def counter(self, name: str, value: float) -> None:
+        pass
+
+    def device_window(self, name: str, seconds: float, steps: int = 1,
+                      **args) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return 0
+
+    def to_chrome(self) -> dict:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        raise ValueError("tracing is disabled; nothing to save")
+
+
+NULL_TRACER = NullTracer()

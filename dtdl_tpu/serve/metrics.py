@@ -17,6 +17,13 @@ with an honest clock:
 * **Throughput** (prefill/decode tokens per second) — wall-clock between
   the first dispatch and the last harvest, the same fetch-ends-the-
   timed-region rule bench.py uses.
+
+Tail percentiles (TTFT / per-token latency p50/p95/p99) come from
+streaming log-bucketed histograms (:class:`dtdl_tpu.obs.hist.
+LogHistogram`): fixed memory under unbounded traffic, fed with the same
+lag-harvested host floats as the means — zero added per-token device
+syncs.  Like every harvest-side number they run up to ``harvest_lag``
+steps late; ``Scheduler.drain`` settles them exactly.
 """
 
 from __future__ import annotations
@@ -24,6 +31,11 @@ from __future__ import annotations
 import time
 
 from dtdl_tpu.metrics.device import MetricsQueue
+from dtdl_tpu.obs.hist import LogHistogram
+
+# exact per-request samples kept for tests/small runs; past this cap only
+# the fixed-memory histograms (which see EVERY sample) keep growing stats
+_MAX_SAMPLES = 65536
 
 
 class ServeMetrics:
@@ -38,8 +50,13 @@ class ServeMetrics:
         self.n_decode_steps = 0
         self.decode_slot_steps = 0      # sum of active slots over steps
         self.prefill_tokens = 0
-        self.ttft_s: list[float] = []
-        self.tok_latency_s: list[float] = []   # per-request mean, decode
+        self.ttft_s: list[float] = []          # exact samples, capped
+        self.tok_latency_s: list[float] = []   # per-request mean, capped
+        # streaming stats (fixed memory, never capped): means AND tails
+        # in summary() come from these, so they stay exact under
+        # unbounded traffic while the sample lists stop at _MAX_SAMPLES
+        self.ttft_hist = LogHistogram()
+        self.tok_latency_hist = LogHistogram()
         self._t_start = None
         self._t_last_harvest = None
         self._occupancy: list[dict] = []
@@ -68,15 +85,20 @@ class ServeMetrics:
 
     def on_first_token(self, req):
         self._t_last_harvest = time.perf_counter()
-        self.ttft_s.append(self._t_last_harvest - req.t_submit)
+        ttft = self._t_last_harvest - req.t_submit
+        if len(self.ttft_s) < _MAX_SAMPLES:
+            self.ttft_s.append(ttft)
+        self.ttft_hist.add(ttft)
 
     def on_finish(self, req):
         self._t_last_harvest = time.perf_counter()
         self.n_finished += 1
         n_decoded = len(req.tokens) - 1
         if n_decoded > 0:
-            self.tok_latency_s.append(
-                (req.t_done - req.t_first) / n_decoded)
+            per_tok = (req.t_done - req.t_first) / n_decoded
+            if len(self.tok_latency_s) < _MAX_SAMPLES:
+                self.tok_latency_s.append(per_tok)
+            self.tok_latency_hist.add(per_tok)
 
     # ---- aggregation --------------------------------------------------
 
@@ -101,8 +123,13 @@ class ServeMetrics:
             "wall_s": round(wall, 6),
             "decode_tokens_per_sec": round(decode_tokens / wall, 2)
             if wall > 0 else 0.0,
-            "ttft_s_mean": round(mean(self.ttft_s), 6),
-            "tok_latency_s_mean": round(mean(self.tok_latency_s), 6),
             "occupancy_mean": round(
                 mean(occ) / self.n_slots if self.n_slots else 0.0, 4),
+            # lag-harvested latency means + tails from the histograms'
+            # exact running stats (they see every sample even past the
+            # capped lists); the 0.0 defaults keep the mean keys present
+            # under zero traffic, where summary() emits no fields
+            "ttft_s_mean": 0.0, "tok_latency_s_mean": 0.0,
+            **self.ttft_hist.summary("ttft_s_"),
+            **self.tok_latency_hist.summary("tok_latency_s_"),
         }

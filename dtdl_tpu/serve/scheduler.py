@@ -37,6 +37,7 @@ from typing import Any, Optional, Sequence
 import jax
 import numpy as np
 
+from dtdl_tpu.obs.observer import NULL_OBSERVER
 from dtdl_tpu.serve.engine import InferenceEngine
 from dtdl_tpu.serve.metrics import ServeMetrics
 from dtdl_tpu.serve.sampling import GREEDY, SampleParams
@@ -84,10 +85,16 @@ class Scheduler:
     """
 
     def __init__(self, engine: InferenceEngine, seed: int = 0,
-                 harvest_lag: int = 4, metrics: ServeMetrics = None):
+                 harvest_lag: int = 4, metrics: ServeMetrics = None,
+                 observer=None):
         if harvest_lag < 0:
             raise ValueError(f"harvest_lag must be >= 0, got "
                              f"{harvest_lag}")
+        # obs facade: thread-safe spans (admit/dispatch/harvest) + the
+        # engine's recompile sentinel; defaults to all-no-ops
+        self.observer = observer or NULL_OBSERVER
+        if observer is not None and engine.observer is None:
+            engine.observer = observer   # sentinel on prefill/decode jits
         self.engine = engine
         self.arena = engine.init_arena()
         self.last_tokens = engine.init_last_tokens()
@@ -171,16 +178,18 @@ class Scheduler:
 
     def step(self) -> int:
         """One admit + decode round; returns how many slots decoded."""
-        self._admit()
+        with self.observer.span("admit"):
+            self._admit()
         n_active = int(self._active.sum())
         if n_active:
             entries = []
             for slot, req in enumerate(self.slots):
                 if self._active[slot]:
                     entries.append((slot, req.rid, req._dispatched))
-            self.arena, self.last_tokens, _ = self.engine.decode(
-                self.arena, self.last_tokens, self._active,
-                self._next_key(), self._temp, self._topk, self._topp)
+            with self.observer.span("dispatch", n_active=n_active):
+                self.arena, self.last_tokens, _ = self.engine.decode(
+                    self.arena, self.last_tokens, self._active,
+                    self._next_key(), self._temp, self._topk, self._topp)
             self._pending.append((self.last_tokens, tuple(entries)))
             for slot, req in enumerate(self.slots):
                 if self._active[slot]:
@@ -189,8 +198,10 @@ class Scheduler:
                         self._retire(slot)
         self.step_count += 1
         self.metrics.on_step(n_active, self.engine.n_slots)
-        while len(self._pending) > self.harvest_lag:
-            self._harvest_one()
+        if len(self._pending) > self.harvest_lag:
+            with self.observer.span("harvest"):
+                while len(self._pending) > self.harvest_lag:
+                    self._harvest_one()
         return n_active
 
     # ---- harvest ------------------------------------------------------
@@ -221,8 +232,9 @@ class Scheduler:
 
     def drain(self):
         """Harvest everything still in flight (the boundary sync)."""
-        while self._pending:
-            self._harvest_one()
+        with self.observer.span("drain"):
+            while self._pending:
+                self._harvest_one()
 
     # ---- driver -------------------------------------------------------
 

@@ -106,12 +106,14 @@ class Estimator:
 
     def __init__(self, model_fn: Callable, model_dir: str = "./estimator",
                  config: RunConfig | None = None, params: dict | None = None,
-                 strategy: Strategy | None = None):
+                 strategy: Strategy | None = None, observer=None):
+        from dtdl_tpu.obs.observer import NULL_OBSERVER
         self.model_fn = model_fn
         self.model_dir = model_dir
         self.config = config or RunConfig()
         self.params = params or {}
         self.strategy = strategy or SingleDevice()
+        self.observer = observer or NULL_OBSERVER
         self.ckpt = Checkpointer(model_dir,
                                  keep=self.config.keep_checkpoint_max)
         self.reporter = Reporter([StdoutSink()])
@@ -173,7 +175,8 @@ class Estimator:
                 self.strategy, **({"loss_fn": spec.loss_fn} if spec.loss_fn
                                   else {}),
                 seed=self.config.tf_random_seed)
-        train_step = self._compiled["train"]
+        train_step = self.observer.watch(self._compiled["train"],
+                                         "estimator.train_step")
         cfg = self.config
         # async dispatch discipline (SCALING.md): the loop dispatches
         # back-to-back and syncs ONCE per log_step_count_steps — the drain
@@ -199,20 +202,26 @@ class Estimator:
                 for batch in it:
                     if global_step >= target:
                         break
-                    state, metrics = train_step(state, batch)
+                    with self.observer.span("dispatch",
+                                            global_step=global_step):
+                        state, metrics = train_step(state, batch)
                     global_step += 1
                     queue.push(metrics)
                     if (cfg.log_step_count_steps
                             and global_step % cfg.log_step_count_steps == 0):
-                        drained = queue.drain()   # blocks on current step
+                        with self.observer.span("drain"):
+                            drained = queue.drain()  # blocks on current step
                         dt = time.time() - t0
                         rate = (global_step - logged_at) / max(dt, 1e-9)
+                        goodput = self.observer.window(
+                            global_step - logged_at, dt)
                         t0, logged_at = time.time(), global_step
                         self.reporter.report({
                             "global_step": global_step,
                             "loss": drained[-1]["loss"] if drained
                             else float(metrics["loss"]),
                             "global_step/sec": round(rate, 2),
+                            **goodput,
                         })
                     if (cfg.save_checkpoints_steps
                             and global_step % cfg.save_checkpoints_steps == 0):

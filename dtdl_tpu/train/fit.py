@@ -169,7 +169,11 @@ class Model:
 
     def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
             validation_data=None, callbacks: Sequence[Callback] = (),
-            shuffle: bool = True, seed: int = 0, verbose: int = 1) -> History:
+            shuffle: bool = True, seed: int = 0, verbose: int = 1,
+            observer=None) -> History:
+        from dtdl_tpu.obs.observer import NULL_OBSERVER
+        import time as _time
+        obs = observer or NULL_OBSERVER
         x = np.asarray(x)
         y = np.asarray(y)
         self._ensure_state(x)
@@ -180,6 +184,7 @@ class Model:
             cb.on_train_begin()
         reporter = Reporter([StdoutSink()]) if verbose else None
         loader = self._loader(x, y, batch_size, shuffle, seed)
+        step_fn = obs.watch(self._train_step, "fit.train_step")
         try:
             for epoch in range(epochs):
                 for cb in cbs:
@@ -194,13 +199,21 @@ class Model:
                 queue = MetricsQueue()
                 it = prefetch_to_device(iter(loader),
                                         self.strategy.shard_batch)
+                n_steps, t0 = 0, _time.perf_counter()
                 for batch in it:
-                    self.state, metrics = self._train_step(self.state, batch)
+                    with obs.span("dispatch", epoch=epoch):
+                        self.state, metrics = step_fn(self.state, batch)
+                    n_steps += 1
                     for vals in queue.push(metrics):
                         acc.add(vals)
-                for vals in queue.drain():
-                    acc.add(vals)
+                with obs.span("drain", epoch=epoch):
+                    for vals in queue.drain():
+                        acc.add(vals)
                 logs = acc.means()
+                # the drain settled every dispatched step: the epoch's
+                # train section is an honest goodput window
+                logs.update(obs.window(n_steps,
+                                       _time.perf_counter() - t0))
                 if validation_data is not None:
                     vx, vy = validation_data
                     val = self.evaluate(vx, vy, batch_size=batch_size,

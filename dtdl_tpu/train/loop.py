@@ -32,6 +32,7 @@ from functools import partial
 from dtdl_tpu.data.loader import prefetch_to_device
 from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Accumulator, Reporter
+from dtdl_tpu.obs.observer import NULL_OBSERVER, Observer
 from dtdl_tpu.parallel.strategy import Strategy
 from dtdl_tpu.utils.timing import StepTimer
 
@@ -103,7 +104,7 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
                 log_interval: int = 20, timer: StepTimer | None = None,
                 prefetch: int = 2, profile_dir: str | None = None,
                 sync_every_step: bool = False, lag: int | None = None,
-                unroll: int = 1):
+                unroll: int = 1, observer: Observer | None = None):
     """Run one epoch; returns (state, epoch_mean_metrics).
 
     Async by default: metrics are drained (one host↔device sync) once per
@@ -115,6 +116,12 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
 
     ``profile_dir`` captures a jax.profiler (XLA op-level) trace of the
     epoch — the device-side observability the reference lacked (SURVEY §5.1).
+
+    ``observer`` (dtdl_tpu.obs) adds host-phase spans (data/dispatch/
+    drain), a recompile sentinel on the step fn, and per-window goodput
+    fields merged into the boundary reports — all host-side, so the
+    one-sync-per-window contract is unchanged (pinned by
+    tests/test_obs.py's sync-counting test).
     """
     from dtdl_tpu.utils.profiling import maybe_trace, step_annotation
     if unroll < 1:
@@ -122,6 +129,7 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
     if sync_every_step and unroll > 1:
         raise ValueError("unroll > 1 dispatches one program per bundle; "
                          "sync_every_step has no per-step value to block on")
+    obs = observer or NULL_OBSERVER
     timer = timer or StepTimer(blocking=sync_every_step)
     timer.reset_epoch()
     acc = Accumulator()
@@ -133,11 +141,14 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
     it = prefetch_to_device(iter(loader), strategy.shard_batch, prefetch)
 
     if sync_every_step:
+        step_fn = obs.watch(train_step, "train_step")
         with maybe_trace(profile_dir):
             for i, batch in enumerate(it):
-                with step_annotation(i):
-                    state, metrics = train_step(state, batch)
+                with step_annotation(i), obs.span("dispatch", step=i):
+                    state, metrics = step_fn(state, batch)
                 timer.step(metrics["loss"])
+                # blocking mode: every step is its own settled window
+                goodput = obs.window(1, timer.last_step_s)
                 acc.add({k: float(v) for k, v in metrics.items()})
                 if reporter is not None and (i % log_interval) == 0:
                     reporter.report({
@@ -145,6 +156,7 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
                         "steps_per_epoch": steps_per_epoch,
                         **{k: float(v) for k, v in metrics.items()},
                         "batch_time": timer.last_step_s,
+                        **goodput,
                     })
         if reporter is not None:
             reporter.report({
@@ -157,20 +169,29 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
 
     queue = MetricsQueue(lag if lag is not None else max(log_interval, 1))
     if unroll > 1:
-        step_fn = unroll_steps(train_step, unroll)
+        # wrap AFTER the bundled-wrapper cache (its key is the original
+        # step fn's id); expected=2 budgets the ragged tail's one
+        # legitimate recompile
+        step_fn = obs.watch(unroll_steps(train_step, unroll),
+                            "train_step_bundle", expected=2)
         it = bundle_batches(it, unroll)
+    else:
+        step_fn = obs.watch(train_step, "train_step")
     latest: dict | None = None
     next_log = 0
     step0 = 0
+    window_start = 0          # first step of the current obs/goodput window
+    it = iter(it)
+    _END = object()
     with maybe_trace(profile_dir):
-        for batch in it:
-            with step_annotation(step0):
-                if unroll > 1:
-                    state, metrics = step_fn(state, batch)
-                    n = len(batch)
-                else:
-                    state, metrics = train_step(state, batch)
-                    n = 1
+        while True:
+            with obs.span("data"):
+                batch = next(it, _END)
+            if batch is _END:
+                break
+            with step_annotation(step0), obs.span("dispatch", step=step0):
+                state, metrics = step_fn(state, batch)
+                n = len(batch) if unroll > 1 else 1
             for _ in range(n):
                 timer.step()
             popped = queue.push(metrics, count=n)
@@ -181,23 +202,33 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
             if reporter is not None and step0 >= next_log:
                 # boundary: ONE drain converts the whole window (blocks on
                 # the just-dispatched step) — the only sync in the window
-                drained = queue.drain()
+                with obs.span("drain", steps=step0 + n - window_start):
+                    drained = queue.drain()
                 for vals in drained:
                     acc.add(vals)
                 if drained:
                     latest = drained[-1]
                 timer.sync()
+                w = step0 + n - window_start
+                window_start = step0 + n
                 reporter.report({
                     "epoch": epoch, "step": step0 + n - 1,
                     "steps_per_epoch": steps_per_epoch,
                     **(latest or {}),
                     "batch_time": timer.last_step_s,
+                    # settled-window goodput (host floats only — the drain
+                    # above was the window's one sync)
+                    **obs.window(w, timer.last_step_s * w),
                 })
                 next_log = (step0 // log_interval + 1) * log_interval
             step0 += n
-    for vals in queue.drain():
-        acc.add(vals)
+    with obs.span("drain", steps=step0 - window_start):
+        for vals in queue.drain():
+            acc.add(vals)
     timer.sync()
+    if step0 > window_start:
+        obs.window(step0 - window_start, timer.last_step_s
+                   * (step0 - window_start))
     if reporter is not None:
         reporter.report({
             "epoch": epoch, "split": "train_epoch",
@@ -228,7 +259,8 @@ def _pad_and_mask(batch, target: int):
 
 def evaluate(eval_step, state, loader, strategy: Strategy,
              reporter: Reporter | None = None, epoch: int = 0,
-             prefetch: int = 2, lag: int = 8):
+             prefetch: int = 2, lag: int = 8,
+             observer: Observer | None = None):
     """Full-dataset evaluation; returns exact global mean metrics.
 
     Handles ragged tail batches (DataLoader(drop_last=False)) by padding to
@@ -251,9 +283,14 @@ def evaluate(eval_step, state, loader, strategy: Strategy,
             for k in sums:
                 sums[k] += vals[k]
 
+    obs = observer or NULL_OBSERVER
+    eval_fn = obs.watch(eval_step, "eval_step")
     for batch in it:
-        absorb(queue.push(eval_step(state, batch)))
-    absorb(queue.drain())
+        with obs.span("dispatch", phase="eval"):
+            metrics = eval_fn(state, batch)
+        absorb(queue.push(metrics))
+    with obs.span("drain", phase="eval"):
+        absorb(queue.drain())
     if sums["count"] == 0:
         return {"loss": float("nan"), "accuracy": float("nan")}
     means = {"loss": sums["loss_sum"] / sums["count"],

@@ -124,7 +124,10 @@ class Solver:
 
     def __init__(self, solver_path_or_msg, train_loader, test_loader=None,
                  strategy: Strategy | None = None, dtype=jnp.float32,
-                 out: str | None = None, overrides: dict | None = None):
+                 out: str | None = None, overrides: dict | None = None,
+                 observer=None):
+        from dtdl_tpu.obs.observer import NULL_OBSERVER
+        self.observer = observer or NULL_OBSERVER
         sp = (parse_file(solver_path_or_msg)
               if isinstance(solver_path_or_msg, str) else solver_path_or_msg)
         # overrides must land BEFORE the optimizer is built: lr policies
@@ -234,6 +237,9 @@ class Solver:
         # the iteration it just dispatched
         queue = MetricsQueue(max(display, 1) if display else 8)
         newest: dict = {}
+        step_fn = self.observer.watch(self.train_step, "solver.train_step")
+        import time as _time
+        t_disp, iters_at_disp = _time.perf_counter(), self.iteration
         try:
             steps_per_pass = len(self.train_loader)
         except TypeError:
@@ -256,7 +262,9 @@ class Solver:
                 for batch in it:
                     if self.iteration >= self.max_iter:
                         break
-                    self.state, metrics = self.train_step(self.state, batch)
+                    with self.observer.span("dispatch",
+                                            iteration=self.iteration):
+                        self.state, metrics = step_fn(self.state, batch)
                     batches += 1
                     if batches % iter_size:
                         continue  # mid-accumulation: not an iteration yet
@@ -265,11 +273,18 @@ class Solver:
                     if popped:
                         newest = popped[-1]
                     if display and self.iteration % display == 0:
-                        drained = queue.drain()   # the window's one sync
+                        with self.observer.span("drain"):
+                            drained = queue.drain()  # the window's one sync
                         if drained:
                             newest = drained[-1]
                         last = newest
-                        self.reporter.report({"iter": self.iteration, **last})
+                        goodput = self.observer.window(
+                            self.iteration - iters_at_disp,
+                            _time.perf_counter() - t_disp)
+                        t_disp = _time.perf_counter()
+                        iters_at_disp = self.iteration
+                        self.reporter.report({"iter": self.iteration, **last,
+                                              **goodput})
                     if (test_interval and self.test_loader is not None
                             and self.iteration % test_interval == 0):
                         last = self.test()

@@ -29,6 +29,7 @@ from dtdl_tpu.ckpt.checkpoint import Checkpointer
 from dtdl_tpu.data.loader import prefetch_to_device, resume_iter
 from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Accumulator, JsonlSink, Reporter, StdoutSink
+from dtdl_tpu.obs.observer import NULL_OBSERVER
 from dtdl_tpu.parallel.strategy import Strategy
 from dtdl_tpu.runtime.bootstrap import is_leader
 from dtdl_tpu.utils.timing import StepTimer
@@ -78,9 +79,12 @@ class Trainer:
 
     def __init__(self, state, train_step, train_loader, strategy: Strategy,
                  stop_trigger=(20, "epoch"), out: str = "./result",
-                 prefetch: int = 2, metrics_lag: int = 20):
+                 prefetch: int = 2, metrics_lag: int = 20, observer=None):
         self.state = state
         self.train_step = train_step
+        # obs facade (dtdl_tpu.obs): spans + recompile sentinel + goodput;
+        # the default NULL_OBSERVER no-ops every hook
+        self.observer = observer or NULL_OBSERVER
         self.train_loader = train_loader
         self.strategy = strategy
         self.stop = Trigger.of(stop_trigger)
@@ -129,12 +133,17 @@ class Trainer:
         per-period means and the final ``observation`` are bitwise what the
         old sync-every-iteration loop produced.
         """
-        drained = self.metrics_queue.drain()
+        with self.observer.span("drain"):
+            drained = self.metrics_queue.drain()
         for vals in drained:
             self.observation = vals
             self.accumulator.add(vals)
         if drained:
             self.timer.sync()
+            # settled window = exactly the drained steps; goodput fields
+            # land in observation so LogReport/PrintReport can select them
+            self.observation.update(self.observer.window(
+                len(drained), self.timer.last_step_s * len(drained)))
 
     # -- run loop -------------------------------------------------------------
 
@@ -152,6 +161,7 @@ class Trainer:
             self.ckpt.wait_until_finished()
 
     def _run(self) -> None:
+        step_fn = self.observer.watch(self.train_step, "trainer.train_step")
         while not self._done:
             self.train_loader.set_epoch(self.epoch)
             self.timer.reset_epoch()
@@ -171,7 +181,8 @@ class Trainer:
             it = prefetch_to_device(raw, self.strategy.shard_batch,
                                     self.prefetch)
             for batch in it:
-                self.state, metrics = self.train_step(self.state, batch)
+                with self.observer.span("dispatch", iteration=self.iteration):
+                    self.state, metrics = step_fn(self.state, batch)
                 self.iteration += 1
                 self.iteration_in_epoch += 1
                 self.timer.step()

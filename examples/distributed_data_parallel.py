@@ -77,13 +77,15 @@ def main():
     sinks = [StdoutSink(prefix=f"[p{jax.process_index()}]")]
     if is_leader():
         sinks.append(JsonlSink(f"{args.out}/log.jsonl"))
-    reporter = Reporter(sinks)
-    for epoch in range(args.epochs):
-        state, _ = train_epoch(step, state, train_loader, strategy,
-                               reporter=reporter, epoch=epoch,
-                               log_interval=args.log_interval)
-        evaluate(eval_step, state, val_loader, strategy,
-                 reporter=reporter, epoch=epoch)
+    # context-managed reporter: the JSONL sink is closed/flushed even if
+    # an epoch raises, so the log file never loses its tail to a crash
+    with Reporter(sinks) as reporter:
+        for epoch in range(args.epochs):
+            state, _ = train_epoch(step, state, train_loader, strategy,
+                                   reporter=reporter, epoch=epoch,
+                                   log_interval=args.log_interval)
+            evaluate(eval_step, state, val_loader, strategy,
+                     reporter=reporter, epoch=epoch)
     if args.save_model:
         ckpt = Checkpointer(args.out)
         path = ckpt.save_final(state.params)

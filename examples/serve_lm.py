@@ -45,6 +45,10 @@ def main():
          help="steps a sampled token may stay device-side before the "
               "host reads it (0 = sync every step)")
     flag(parser, "--seed", type=int, default=0)
+    flag(parser, "--trace", default="",
+         help="write a Chrome-trace-event JSON (Perfetto-loadable) of "
+              "the scheduler phases (admit/dispatch/harvest) to this "
+              "path")
     args = parser.parse_args()
     bootstrap(args)
     seed_everything(args.seed)
@@ -59,9 +63,12 @@ def main():
         from dtdl_tpu.ckpt import load_weights
         params = load_weights(args.restore, params)
 
-    engine = InferenceEngine(model, params, n_slots=args.n_slots)
+    from dtdl_tpu.obs import Observer
+    obs = Observer(trace_path=args.trace or None, sentinel="warn")
+    engine = InferenceEngine(model, params, n_slots=args.n_slots,
+                             observer=obs)
     sched = Scheduler(engine, seed=args.seed,
-                      harvest_lag=args.harvest_lag)
+                      harvest_lag=args.harvest_lag, observer=obs)
     sp = SampleParams(temperature=args.temperature, top_k=args.top_k,
                       top_p=args.top_p)
 
@@ -82,7 +89,15 @@ def main():
     print(f"served {s['requests_finished']} requests in {dt:.2f}s  "
           f"(decode {s['decode_tokens_per_sec']} tok/s, occupancy "
           f"{s['occupancy_mean']:.0%}, ttft {s['ttft_s_mean'] * 1e3:.1f}ms)")
+    if "ttft_s_p50" in s:
+        print(f"  ttft p50/p95/p99: {s['ttft_s_p50'] * 1e3:.1f} / "
+              f"{s['ttft_s_p95'] * 1e3:.1f} / {s['ttft_s_p99'] * 1e3:.1f} ms"
+              f"   per-token p50/p99: "
+              f"{s.get('tok_latency_s_p50', 0.0) * 1e3:.2f} / "
+              f"{s.get('tok_latency_s_p99', 0.0) * 1e3:.2f} ms")
     print("compiled programs:", engine.compile_stats())
+    if args.trace:
+        print(f"trace written to {obs.save()}", flush=True)
 
 
 if __name__ == "__main__":

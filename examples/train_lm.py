@@ -22,6 +22,8 @@ from dtdl_tpu.ckpt import save_weights
 from dtdl_tpu.data import DataLoader, ShardedSampler, load_dataset
 from dtdl_tpu.metrics import Reporter, StdoutSink
 from dtdl_tpu.models import transformer_lm
+from dtdl_tpu.obs import (GoodputMeter, Observer, lm_train_flops,
+                          peak_flops_per_chip)
 from dtdl_tpu.parallel import choose_strategy
 from dtdl_tpu.train import init_state, make_lm_train_step
 from dtdl_tpu.utils import seed_everything
@@ -67,6 +69,9 @@ def main():
          help=">0: after training, greedily decode this many tokens from "
               "a training-prefix prompt (KV-cache generate) and print "
               "them — an end-to-end check of the inference path")
+    flag(parser, "--trace", default="",
+         help="write a Chrome-trace-event JSON (Perfetto-loadable) of "
+              "the host phases + settled device windows to this path")
     args = parser.parse_args()
 
     if args.dataset != "synthetic_lm":
@@ -102,23 +107,53 @@ def main():
                               vocab_chunk_size=args.vocab_chunk_size,
                               moe_aux_weight=args.moe_aux_weight)
 
-    reporter = Reporter([StdoutSink()])
+    # observability (dtdl_tpu.obs): goodput/MFU per log window through the
+    # reporter, a recompile sentinel on the step, and — with --trace — a
+    # Perfetto-loadable span trace of the host phases
+    per_host_bs = args.batch_size // nproc
+    # flops_per_step covers the whole per-host step (sharded over all
+    # local devices), so the peak must be per-host too — per-chip peak
+    # times local chips, matching bench.py's per-device convention
+    peak = peak_flops_per_chip()
+    obs = Observer(trace_path=args.trace or None, sentinel="warn",
+                   goodput=GoodputMeter(
+                       flops_per_step=lm_train_flops(model, per_host_bs,
+                                                     args.seq_len),
+                       tokens_per_step=per_host_bs * (args.seq_len - 1),
+                       peak_flops=peak * jax.local_device_count()
+                       if peak else None))
+    step = obs.watch(step, "lm_train_step")
     global_step = 0
-    for epoch in range(args.epochs):
-        loader.set_epoch(epoch)
-        for batch in loader:
-            sharded = strategy.shard_batch(
-                {"tokens": jnp.asarray(batch["tokens"])})
-            state, metrics = step(state, sharded)
-            if global_step % args.log_interval == 0:
-                row = {"epoch": epoch, "step": global_step,
-                       "loss": float(metrics["loss"]),
-                       "accuracy": float(metrics["accuracy"]),
-                       "ppl": float(np.exp(min(20.0, float(metrics["loss"]))))}
-                if "moe_aux_loss" in metrics:
-                    row["moe_aux_loss"] = float(metrics["moe_aux_loss"])
-                reporter.report(row)
-            global_step += 1
+    import time as _time
+    t_win, steps_win = _time.perf_counter(), 0
+    with Reporter([StdoutSink()]) as reporter:
+        for epoch in range(args.epochs):
+            loader.set_epoch(epoch)
+            for batch in loader:
+                with obs.span("data"):
+                    sharded = strategy.shard_batch(
+                        {"tokens": jnp.asarray(batch["tokens"])})
+                with obs.span("dispatch", step=global_step):
+                    state, metrics = step(state, sharded)
+                steps_win += 1
+                if global_step % args.log_interval == 0:
+                    with obs.span("drain"):
+                        row = {"epoch": epoch, "step": global_step,
+                               "loss": float(metrics["loss"]),
+                               "accuracy": float(metrics["accuracy"]),
+                               "ppl": float(np.exp(
+                                   min(20.0, float(metrics["loss"]))))}
+                        if "moe_aux_loss" in metrics:
+                            row["moe_aux_loss"] = float(
+                                metrics["moe_aux_loss"])
+                    # the float() above settled the window: honest goodput
+                    row.update(obs.window(steps_win,
+                                          _time.perf_counter() - t_win))
+                    t_win, steps_win = _time.perf_counter(), 0
+                    reporter.report(row)
+                global_step += 1
+    if args.trace:
+        print(f"trace written to {obs.save()}", flush=True)
     if args.save_model:
         path = save_weights(f"{args.out}/lm_final.msgpack", state.params)
         print(f"saved weights to {path}", flush=True)

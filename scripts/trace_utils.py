@@ -1,48 +1,19 @@
 """Shared hand-parser for jax.profiler trace JSON (no tensorboard dep).
 
-The tensorboard_plugin_profile converter is incompatible with this box's
-TF, so the raw Chrome-trace JSON is parsed directly.  On this backend the
-XLA op events live at pid 3 / tid 3; each carries ``hlo_category`` and
-``bytes_accessed`` in its args.
+The implementation moved into the observability subsystem
+(dtdl_tpu/obs/trace.py, PR 3) so the serving/training tracer and the
+profile scripts read Chrome-trace JSON with one parser; this module
+stays as the import path the profile scripts (and any user scripts)
+already use: ``from trace_utils import aggregate, xla_events``.
 """
-import collections
-import glob
-import gzip
-import json
+import os
+import sys
 
-XLA_PID = XLA_TID = 3
+# the scripts run from scripts/ (cwd) without the repo root on sys.path
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-
-def xla_events(trace_dir):
-    """XLA op events of the newest trace under ``trace_dir``."""
-    path = sorted(glob.glob(
-        trace_dir + "/plugins/profile/*/*.trace.json.gz"))[-1]
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
-    return [e for e in trace["traceEvents"]
-            if e.get("ph") == "X" and e.get("pid") == XLA_PID
-            and e.get("tid") == XLA_TID]
-
-
-def aggregate(events, key_fn):
-    """Sum durations/calls/bytes of ``events`` grouped by ``key_fn``.
-
-    Returns (groups, total_s): groups maps key -> [dur_s, calls,
-    hlo_category, bytes_accessed], sorted by descending time.
-    """
-    groups = collections.defaultdict(lambda: [0.0, 0, "", 0.0])
-    total = 0.0
-    for e in events:
-        dur = e.get("dur", 0) / 1e6          # us -> s
-        total += dur
-        args = e.get("args", {})
-        rec = groups[key_fn(e, args)]
-        rec[0] += dur
-        rec[1] += 1
-        rec[2] = args.get("hlo_category", rec[2])
-        try:
-            rec[3] += float(args.get("bytes_accessed", 0) or 0)
-        except (TypeError, ValueError):
-            pass
-    ordered = dict(sorted(groups.items(), key=lambda kv: -kv[1][0]))
-    return ordered, total
+from dtdl_tpu.obs.trace import (  # noqa: E402,F401
+    XLA_PID, XLA_TID, aggregate, xla_events,
+)
