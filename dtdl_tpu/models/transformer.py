@@ -145,9 +145,10 @@ class Attention(nn.Module):
         positions** (the serving arena: each batch row is an independent
         slot at its own decode position, so one compiled step serves a
         continuously-batched mix of sequence lengths).  The vector path
-        is single-token only (S = 1) — prefill happens per slot at
-        scalar index and is scattered into the arena by the engine
-        (dtdl_tpu/serve/engine.py).
+        takes S >= 1 tokens per row (:meth:`_verify_attend_slots`): S = 1
+        is the decode step, S = k+1 the speculative-decoding verify pass
+        — prefill happens per slot at scalar index and is scattered into
+        the arena by the engine (dtdl_tpu/serve/engine.py).
         """
         import math
         b, h, s_new, d = q.shape
@@ -183,7 +184,7 @@ class Attention(nn.Module):
                     f"token(s) exceeds max_seq={max_len}; the cache "
                     f"index would clamp and corrupt the last row")
         if pos.ndim:
-            return self._decode_attend_slots(q, k, v, cos, sin,
+            return self._verify_attend_slots(q, k, v, cos, sin,
                                              ck, cv, ci, pos)
         q = apply_rope(q, cos, sin, offset=pos)
         k = apply_rope(k, cos, sin, offset=pos)
@@ -224,22 +225,36 @@ class Attention(nn.Module):
         out = jnp.moveaxis(out, 0, 2).reshape(b, h, s_new + pad, d)
         return out[:, :, :s_new]
 
-    def _decode_attend_slots(self, q, k, v, cos, sin, ck, cv, ci, pos):
-        """Vector-index decode: row b is an independent slot at position
-        ``pos[b]``.  Same math as the scalar path per row — rope at the
-        row's own global position, K/V scattered into the row's cache at
-        ``pos[b]``, causal mask per row — so a continuously-batched step
-        is token-identical to stepping each slot alone (pinned by
-        tests/test_serve.py).
+    def _verify_attend_slots(self, q, k, v, cos, sin, ck, cv, ci, pos):
+        """Vector-index cached attention, ``s_new`` tokens per slot: row b
+        is an independent slot whose new tokens sit at global positions
+        ``pos[b] .. pos[b]+s_new-1``.  Same math as the scalar path per
+        row — rope at each token's own global position, K/V scattered
+        into the row's cache at ``pos[b]``, causal mask per query row —
+        so scoring k candidate positions in one pass is token-identical
+        to k sequential single-token decodes (pinned by
+        tests/test_spec_decode.py; ``s_new=1`` is exactly the decode step
+        the serving engine compiles, pinned by tests/test_serve.py).
+
+        This is the verify half of speculative decoding: one parameter
+        sweep scores ``s_new`` candidate tokens per slot against the KV
+        arena (dtdl_tpu/serve/engine.py builds the accept/advance logic
+        on top).  The index advances by the full ``s_new``; a caller that
+        commits fewer tokens (rejected candidates) rolls the index leaves
+        back itself — the overwritten-before-attended discipline makes
+        the stale K/V rows beyond the committed index harmless, exactly
+        like prefill's pad positions.
+
+        Callers must guarantee ``pos[b] + s_new <= max_seq`` for every
+        row that matters: the per-row scatter clamps its start index, so
+        an overflowing write would land misaligned over live positions
+        (jitted callers bound-check before tracing — the serving
+        scheduler settles worst-case indices before dispatch; eager
+        callers are checked in ``_decode_attend``).
         """
         import math
         b, h, s_new, d = q.shape
         max_len = cos.shape[0]
-        if s_new != 1:
-            raise ValueError(
-                f"a per-slot (vector-index) cache decodes one token per "
-                f"row at a time, got {s_new}; prefill per slot at scalar "
-                f"index and scatter into the arena instead")
         rope_row = jax.vmap(
             lambda xb, p: apply_rope(xb[None], cos, sin, offset=p)[0])
         q = rope_row(q, pos)
@@ -249,13 +264,15 @@ class Attention(nn.Module):
                 buf, new, (0, p, 0)))
         ck.value = scatter_row(ck.value, k.astype(self.dtype), pos)
         cv.value = scatter_row(cv.value, v.astype(self.dtype), pos)
-        ci.value = pos + 1
+        ci.value = pos + s_new
 
         scale = 1.0 / math.sqrt(d)
-        mask = jnp.arange(max_len)[None, :] <= pos[:, None]     # [B, max]
+        qpos = pos[:, None] + jnp.arange(s_new)[None, :]        # [B, S]
+        mask = (jnp.arange(max_len)[None, None, :]
+                <= qpos[:, :, None])                            # [B, S, max]
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
                             preferred_element_type=jnp.float32)
-        logits = jnp.where(mask[:, None, None, :], logits * scale, -1e30)
+        logits = jnp.where(mask[:, None], logits * scale, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd",
                           probs.astype(self.dtype), cv.value)

@@ -28,7 +28,7 @@ Quick start::
 
 from dtdl_tpu.obs.goodput import (  # noqa: F401
     GoodputMeter, lm_decode_flops, lm_forward_flops, lm_prefill_flops,
-    lm_train_flops, netspec_flops, peak_flops_per_chip,
+    lm_train_flops, lm_verify_flops, netspec_flops, peak_flops_per_chip,
 )
 from dtdl_tpu.obs.hist import LogHistogram  # noqa: F401
 from dtdl_tpu.obs.observer import NULL_OBSERVER, Observer  # noqa: F401

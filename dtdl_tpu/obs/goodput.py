@@ -109,6 +109,21 @@ def lm_prefill_flops(cfg, prompt_len: int) -> float:
     return lm_forward_flops(cfg, 1, prompt_len)
 
 
+def lm_verify_flops(cfg, batch: int, context: int, k: int) -> float:
+    """Matmul-only FLOPs of ONE speculative verify pass scoring k drafts
+    (k+1 query positions) per slot at KV length ``context``.
+
+    Essentially ``(k+1) x lm_decode_flops`` — verify stays bandwidth-
+    bound on TPU (the same full parameter read as decode) but amortizes
+    it over up to k+1 accepted tokens, which is the whole speculative-
+    decoding trade (SCALING.md "Speculative decoding arithmetic").
+    Goodput itself needs no new field: accepted tokens flow through the
+    serve metrics' delivered-token count, so ``decode tokens/sec``
+    already counts real tokens, never drafts.
+    """
+    return (k + 1) * lm_decode_flops(cfg, batch, context)
+
+
 # ---------------------------------------------------------------------------
 # CNN FLOPs from a Caffe netspec
 # ---------------------------------------------------------------------------

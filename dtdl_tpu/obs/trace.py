@@ -5,7 +5,11 @@ boundaries the host only enqueues work, so wall-clock prints no longer
 say where host time goes (data? dispatch? the drain?).  This tracer is
 the host-side complement of ``jax.profiler`` (which sees the *device*
 ops): lightweight ``span("data") / span("dispatch") / span("drain")``
-context managers record complete ('X') events on the calling thread,
+context managers record complete ('X') events on the calling thread
+(the serve scheduler adds ``admit`` / ``harvest`` and, under
+speculative decoding, ``draft`` — host time inside the DraftSource —
+and ``verify`` — the k-wide verify dispatch, args carrying the step's
+draft width),
 thread-safe for the serve scheduler, exported as Chrome-trace-event JSON
 that Perfetto / ``chrome://tracing`` loads directly — the same format
 the XLA profiler emits, so the two traces read with the same tools

@@ -1,4 +1,4 @@
-"""Batched LM serving: compiled prefill/decode pair + continuous batcher.
+"""Batched LM serving: compiled prefill/decode/verify + continuous batcher.
 
 The request path the training stack feeds (ROADMAP north star: serve
 heavy traffic): train anywhere (flax/GSPMD or the 4D megatron engine),
@@ -9,16 +9,27 @@ bridge to the flax model, and drive it here —
     sched.submit(Request(prompt, max_new_tokens=64))
     done = sched.run()
 
-See engine.py (the two-XLA-program contract), scheduler.py (slot-based
-continuous batching), sampling.py (per-slot greedy/temperature/top-k/
-top-p), metrics.py (async serving telemetry).
+Speculative decoding is one field away — ``Request(..., speculate=4)``
+verifies up to 4 drafted tokens per parameter sweep, losslessly
+(greedy output is token-identical; sampling is distribution-identical):
+
+    sched = Scheduler(engine, draft=NGramDraft())   # the default source
+    sched.submit(Request(prompt, 64, speculate=4))
+
+See engine.py (the compiled-program contract), scheduler.py (slot-based
+continuous batching + spec integration), draft.py (draft sources),
+sampling.py (per-slot greedy/temperature/top-k/top-p + the
+accept/resample kernel), metrics.py (async serving telemetry).
 """
 
+from dtdl_tpu.serve.draft import (  # noqa: F401
+    DraftSource, ModelDraft, NGramDraft,
+)
 from dtdl_tpu.serve.engine import (  # noqa: F401
-    InferenceEngine, default_buckets,
+    InferenceEngine, PromptTooLongError, default_buckets,
 )
 from dtdl_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from dtdl_tpu.serve.sampling import (  # noqa: F401
-    GREEDY, SampleParams, sample,
+    GREEDY, SampleParams, accept_resample, filter_logits, sample,
 )
 from dtdl_tpu.serve.scheduler import Request, Scheduler  # noqa: F401

@@ -1,0 +1,126 @@
+"""Draft sources for speculative decoding: cheap guesses, free to be wrong.
+
+Speculative decoding splits token generation into a cheap *draft* and a
+batched *verify* (dtdl_tpu/serve/engine.py:InferenceEngine.verify).  The
+verify pass is **lossless by construction** — greedy emits the exact
+argmax prefix, sampling emits tokens distributed exactly as the target
+model's own distribution (serve/sampling.py:accept_resample) — so a
+draft source has only one job: guess what the model was going to say
+anyway, as often as possible, as cheaply as possible.  A bad draft
+costs throughput, never correctness.
+
+Two implementations:
+
+* :class:`NGramDraft` — device-free prompt-lookup drafting (LLMA /
+  prompt-lookup decoding): find the most recent earlier occurrence of
+  the context's trailing n-gram and propose the tokens that followed it.
+  Zero extra parameters, zero device work — pure numpy over the host
+  token history the scheduler already keeps.  Strong whenever output
+  repeats context (summarization, code edits, retrieval) or itself
+  (chat boilerplate, loops); useless on de-novo text, which costs only
+  the drafts' rejected logits.
+* :class:`ModelDraft` — a small draft transformer sharing the target's
+  tokenizer/vocab, run greedily over a trailing context window.  Uses
+  the stock :func:`~dtdl_tpu.models.transformer.generate` scan program,
+  context bucketed to powers of two so the compiled-program family
+  stays bounded (same discipline as the engine's prefill buckets).
+
+The scheduler calls ``propose`` with its *optimistic* host-side context
+— lag-harvested tokens plus in-flight drafts (SCALING.md "Speculative
+decoding arithmetic") — never by syncing the in-flight step, per the
+PR-1 no-added-syncs rule.  ``propose`` may return fewer than ``k``
+tokens (or none): the scheduler just drafts shorter that step.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class DraftSource(Protocol):
+    """Anything that can guess the next tokens of a context."""
+
+    def propose(self, ctx: np.ndarray, k: int) -> np.ndarray:
+        """Up to ``k`` int32 tokens predicted to continue ``ctx`` (a 1-D
+        int array of the known-so-far sequence).  Fewer (or zero) tokens
+        means "no confident guess" — the caller drafts shorter."""
+        ...  # pragma: no cover - protocol
+
+
+class NGramDraft:
+    """Prompt-lookup drafting: the continuation of the most recent
+    earlier occurrence of the trailing n-gram (longest n first).
+
+    ``max_n``/``min_n`` bound the n-gram probe (longer matches are
+    rarer but much more predictive); the longest n with a hit wins.
+    """
+
+    def __init__(self, max_n: int = 3, min_n: int = 1):
+        if not 1 <= min_n <= max_n:
+            raise ValueError(f"need 1 <= min_n <= max_n, got "
+                             f"min_n={min_n} max_n={max_n}")
+        self.max_n = max_n
+        self.min_n = min_n
+
+    def propose(self, ctx, k: int) -> np.ndarray:
+        ctx = np.asarray(ctx, np.int32).ravel()
+        L = ctx.size
+        if L < 2 or k < 1:
+            return np.zeros((0,), np.int32)
+        for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
+            pattern = ctx[L - n:]
+            # windows ending strictly before the trailing pattern itself
+            starts = np.arange(L - n)
+            wins = ctx[starts[:, None] + np.arange(n)[None, :]]
+            hits = np.nonzero((wins == pattern[None, :]).all(axis=1))[0]
+            if hits.size:
+                # most recent occurrence with a FULL k-token continuation
+                # (the lag-gap skip needs length, and under repetition an
+                # earlier cycle is just as predictive); else the longest
+                # continuation available
+                full = hits[hits + n + k <= L]
+                j = int(full[-1] if full.size else hits[0]) + n
+                return ctx[j:j + k].copy()
+        return np.zeros((0,), np.int32)
+
+
+class ModelDraft:
+    """Draft with a small transformer sharing the target's vocab.
+
+    Greedy (deterministic) draft generation over the trailing
+    ``window`` context tokens: determinism is what makes the one-hot
+    proposal treatment in ``accept_resample`` natural, and greedy small-
+    model continuations are the classic draft (Leviathan et al. 2023).
+    The context is truncated to the largest power of two <= min(len,
+    window) so the :func:`generate` scan compiles once per (context
+    bucket, k) pair rather than per length.
+    """
+
+    def __init__(self, model, params, window: int = 32):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        import flax.linen as nn
+        self.model = model
+        self.params = nn.unbox(params)
+        self.window = min(window, model.max_seq - 1)
+
+    def propose(self, ctx, k: int) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from dtdl_tpu.models.transformer import generate
+
+        ctx = np.asarray(ctx, np.int32).ravel()
+        if ctx.size < 1 or k < 1:
+            return np.zeros((0,), np.int32)
+        s0 = 1
+        while s0 * 2 <= min(ctx.size, self.window):
+            s0 *= 2
+        k = min(k, self.model.max_seq - s0)
+        if k < 1:
+            return np.zeros((0,), np.int32)
+        out = generate(self.model, self.params,
+                       jnp.asarray(ctx[None, ctx.size - s0:]), k)
+        return np.asarray(out)[0, s0:].astype(np.int32)

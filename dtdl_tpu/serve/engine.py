@@ -1,10 +1,11 @@
-"""Batched LM inference engine: two XLA programs, a slotted KV arena.
+"""Batched LM inference engine: three XLA program families, a slotted KV
+arena.
 
 The serving problem on TPU is a *compile-shape* problem: XLA programs are
 shape-specialized, so a naive "pad the batch to the longest request and
 re-jit per prompt length" serving loop recompiles on every new shape and
 stalls every request behind the longest one.  This engine fixes the
-shapes once and routes all traffic through exactly two programs per
+shapes once and routes all traffic through a handful of programs per
 model (the Orca/vLLM decomposition, rebuilt XLA-native on static shapes):
 
 * ``prefill(params, arena, last, tokens[1, T], length, slot, ...)`` —
@@ -23,9 +24,26 @@ model (the Orca/vLLM decomposition, rebuilt XLA-native on static shapes):
 * ``decode(params, arena, last[B], active[B], ...)`` — ONE compiled
   program total: every slot advances one token against its own cache
   row at its own position (the model's vector-index cache path,
-  models/transformer.py:_decode_attend_slots).  Inactive slots compute
-  garbage that is masked out of the state (their index does not
+  models/transformer.py:_verify_attend_slots at S=1).  Inactive slots
+  compute garbage that is masked out of the state (their index does not
   advance); occupancy is a runtime *value*, never a compile shape.
+* ``verify(params, arena, last[B], draft[B, k], draft_len[B], ...)`` —
+  the THIRD program family, one per draft width k (the scheduler
+  buckets k to powers of two, so the family stays as small as the
+  prefill one): speculative decoding's verify pass.  One parameter
+  sweep scores the slot's last token plus k drafted candidates against
+  the KV arena (k+1 query positions through the same vector-index
+  path), then per-slot acceptance runs ON DEVICE (exact prefix match
+  for greedy rows, one-hot residual rejection sampling otherwise —
+  dtdl_tpu/serve/sampling.py:accept_resample), the accepted tokens come
+  back as a [B, k+1] window with per-slot counts, and each slot's cache
+  index advances by its own *variable* ``n_accepted + 1`` (the index
+  leaves are rolled back from the model's +k+1; the stale K/V rows of
+  rejected candidates are overwritten before they are ever attended,
+  the same discipline as prefill padding).  Decode is HBM-bandwidth
+  bound — one token per full parameter read — so verify converts the
+  same read into up to k+1 tokens while staying token-losslessly
+  equivalent (SCALING.md "Speculative decoding arithmetic").
 
 The **arena** is the fixed [n_slots, H, max_seq, head_dim] per-block K/V
 buffer pair plus a per-slot position vector (``cache_shapes(...,
@@ -48,7 +66,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dtdl_tpu.serve.sampling import SampleParams, pack, sample
+from dtdl_tpu.serve.sampling import (SampleParams, accept_resample, pack,
+                                     sample)
+
+
+class PromptTooLongError(ValueError):
+    """A prompt exceeds the largest configured prefill bucket.
+
+    Raised by :meth:`InferenceEngine.bucket_for` BEFORE any prefill
+    program is built or traced, with the configured bucket list in the
+    message — the scheduler surfaces it as a rejected request
+    (``Request.error``) instead of letting one oversized prompt crash a
+    run with other requests in flight (dtdl_tpu/serve/scheduler.py).
+    """
 
 
 def default_buckets(max_seq: int, start: int = 16) -> tuple[int, ...]:
@@ -90,6 +120,7 @@ class InferenceEngine:
         self._cache1 = model.cache_shapes(1)
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None
+        self._verify_fns: dict[int, object] = {}
 
     # ---- state the caller threads ------------------------------------
 
@@ -108,9 +139,10 @@ class InferenceEngine:
         for b in self.buckets:
             if length <= b:
                 return b
-        raise ValueError(
-            f"prompt length {length} exceeds the largest bucket "
-            f"{self.buckets[-1]} (max_seq={self.max_seq})")
+        raise PromptTooLongError(
+            f"prompt length {length} exceeds the largest prefill bucket "
+            f"{self.buckets[-1]} (buckets={self.buckets}, "
+            f"max_seq={self.max_seq})")
 
     # ---- compiled programs -------------------------------------------
 
@@ -166,17 +198,53 @@ class InferenceEngine:
 
         return jax.jit(decode, donate_argnums=(1,))
 
+    def _build_verify(self, k: int):
+        model = self.model
+
+        def verify(params, arena, last, draft, draft_len, active, key,
+                   temp, top_k, top_p):
+            # the slots' pre-step cache positions: every block's index
+            # leaf carries the same per-slot values, take the first
+            pos = next(l for l in jax.tree.leaves(arena) if l.ndim == 1)
+            x = jnp.concatenate([last[:, None], draft], axis=1)  # [B,k+1]
+            logits, muts = model.apply(
+                {"params": params, "cache": arena}, x, decode=True,
+                mutable=["cache"])
+            tokens, n_acc = accept_resample(
+                logits.astype(jnp.float32), draft, draft_len, key,
+                temp, top_k, top_p)
+            n_em = n_acc + 1
+
+            def fix(old, new):
+                if old.ndim == 1:
+                    # roll the index back from the model's +k+1 to the
+                    # committed n_accepted+1; inactive slots stay put
+                    return jnp.where(active, pos + n_em, old)
+                return new      # garbage K/V past the committed index is
+            arena = jax.tree.map(fix, arena, muts["cache"])  # overwritten
+            # before it is attended (see module docstring)
+            new_last = jnp.take_along_axis(
+                tokens, n_acc[:, None], axis=1)[:, 0]
+            last = jnp.where(active, new_last, last)
+            tokens = jnp.where(active[:, None], tokens, 0)
+            n_em = jnp.where(active, n_em, 0)
+            return arena, last, tokens, n_em
+
+        return jax.jit(verify, donate_argnums=(1,))
+
     def compile_stats(self) -> dict:
         """Compiled-program counts — the no-per-request-recompile
-        receipt: one entry per touched prefill bucket, one decode
-        program, each with a jit cache size that must stay 1."""
+        receipt: one entry per touched prefill bucket, one per touched
+        verify draft-width bucket, one decode program, each with a jit
+        cache size that must stay 1."""
         def n(f):
             try:
                 return f._cache_size()
             except AttributeError:   # pragma: no cover - jax internals
                 return -1
         return {"prefill": {T: n(f) for T, f in self._prefill_fns.items()},
-                "decode": n(self._decode_fn) if self._decode_fn else 0}
+                "decode": n(self._decode_fn) if self._decode_fn else 0,
+                "verify": {k: n(f) for k, f in self._verify_fns.items()}}
 
     # ---- the two entry points ----------------------------------------
 
@@ -221,3 +289,42 @@ class InferenceEngine:
         return self._decode_fn(self.params, arena, last_tokens,
                                jnp.asarray(active), key, temp, top_k,
                                top_p)
+
+    def verify(self, arena, last_tokens, draft_tokens, draft_len, active,
+               key, temp, top_k, top_p):
+        """One speculative verify pass over every slot: score each slot's
+        ``draft_len[b]`` candidate tokens (``draft_tokens[b, :]``, zero-
+        padded to the program's width k) in one parameter sweep, accept a
+        prefix on device, advance each slot's cache index by its own
+        ``n_accepted + 1``.  Returns ``(arena, last_tokens,
+        tokens[n_slots, k+1], n_emitted[n_slots])`` — ``tokens[b,
+        :n_emitted[b]]`` is what slot b emitted this step (its last entry
+        is the new ``last_tokens[b]``), inactive slots emit 0 tokens.
+
+        The caller must guarantee every active slot has room for the
+        full write window: ``index[b] + k + 1 <= max_seq`` (the
+        scheduler settles worst-case indices before dispatch; a clamped
+        scatter would corrupt live cache rows).  ``k`` is a compile
+        shape — one compiled program per draft width, see
+        :meth:`compile_stats`.
+        """
+        draft_tokens = jnp.asarray(draft_tokens, jnp.int32)
+        if draft_tokens.ndim != 2 or draft_tokens.shape[0] != self.n_slots:
+            raise ValueError(f"draft_tokens must be [n_slots={self.n_slots}"
+                             f", k], got {draft_tokens.shape}")
+        k = int(draft_tokens.shape[1])
+        if k < 1:
+            raise ValueError("verify needs k >= 1 draft positions; use "
+                             "decode for a plain step")
+        if k + 1 > self.max_seq:
+            raise ValueError(f"draft width {k} cannot fit "
+                             f"max_seq={self.max_seq}")
+        if k not in self._verify_fns:
+            fn = self._build_verify(k)
+            if self.observer is not None:
+                fn = self.observer.watch(fn, f"serve.verify[{k}]")
+            self._verify_fns[k] = fn
+        return self._verify_fns[k](
+            self.params, arena, last_tokens, draft_tokens,
+            jnp.asarray(draft_len, jnp.int32), jnp.asarray(active), key,
+            temp, top_k, top_p)

@@ -45,11 +45,22 @@ class ServeMetrics:
         self.queue = queue or MetricsQueue()
         self.n_slots = n_slots
         self.n_submitted = 0
+        self.n_rejected = 0
         self.n_admitted = 0
         self.n_finished = 0
         self.n_decode_steps = 0
         self.decode_slot_steps = 0      # sum of active slots over steps
+        self.decode_tokens_delivered = 0  # harvested generated tokens
         self.prefill_tokens = 0
+        # speculative decoding (lag-harvested, like everything else):
+        # drafted vs accepted candidate counts, verify step count, and
+        # the host time spent inside DraftSource.propose — the honest
+        # draft-overhead ledger against the accepted-token win
+        self.n_verify_steps = 0
+        self.verify_steps_by_k: dict[int, int] = {}
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.draft_s = 0.0
         self.ttft_s: list[float] = []          # exact samples, capped
         self.tok_latency_s: list[float] = []   # per-request mean, capped
         # streaming stats (fixed memory, never capped): means AND tails
@@ -65,6 +76,35 @@ class ServeMetrics:
 
     def on_submit(self, req):
         self.n_submitted += 1
+
+    def on_reject(self, req):
+        """Submit-time rejection (e.g. prompt past the largest bucket —
+        ``req.error`` carries the engine's diagnosis)."""
+        self.n_submitted += 1
+        self.n_rejected += 1
+
+    def on_draft(self, seconds: float):
+        """One drafting phase's host time (dispatch-side; drafted/
+        accepted token counts land at harvest via on_spec_harvest)."""
+        self.draft_s += seconds
+
+    def on_verify(self, k: int):
+        """One verify step dispatched at draft-width bucket ``k``."""
+        self.n_verify_steps += 1
+        self.verify_steps_by_k[k] = self.verify_steps_by_k.get(k, 0) + 1
+
+    def on_spec_harvest(self, drafted: int, accepted: int):
+        """One slot's verify outcome, known at harvest: ``drafted``
+        candidates were scored, ``accepted`` survived."""
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+
+    def on_harvest_tokens(self, n: int):
+        """``n`` generated tokens delivered to a request at harvest
+        (post-trim, excluding the prefill-sampled first token) — the
+        decode-throughput numerator, which under speculative decoding
+        counts exactly the ACCEPTED tokens."""
+        self.decode_tokens_delivered += n
 
     def on_admit(self, req, slot: int, prompt_len: int):
         if self._t_start is None:
@@ -111,18 +151,33 @@ class ServeMetrics:
         wall = 0.0
         if self._t_start is not None and self._t_last_harvest is not None:
             wall = self._t_last_harvest - self._t_start
-        decode_tokens = self.decode_slot_steps
+        decode_tokens = self.decode_tokens_delivered
         occ = [e["n_active"] for e in self._occupancy]
         mean = lambda xs: sum(xs) / len(xs) if xs else 0.0  # noqa: E731
         return {
             "requests_submitted": self.n_submitted,
+            "requests_rejected": self.n_rejected,
             "requests_finished": self.n_finished,
             "prefill_tokens": self.prefill_tokens,
             "decode_steps": self.n_decode_steps,
+            # delivered generated tokens: under speculative decoding this
+            # counts ACCEPTED tokens, so tokens/sec below is the honest
+            # spec-decode win (goodput counts real tokens, never drafts)
             "decode_tokens": decode_tokens,
             "wall_s": round(wall, 6),
             "decode_tokens_per_sec": round(decode_tokens / wall, 2)
             if wall > 0 else 0.0,
+            "tokens_per_step_mean": round(
+                decode_tokens / self.n_decode_steps, 4)
+            if self.n_decode_steps else 0.0,
+            "spec_steps": self.n_verify_steps,
+            "spec_steps_by_k": dict(self.verify_steps_by_k),
+            "spec_drafted_tokens": self.spec_drafted,
+            "spec_accepted_tokens": self.spec_accepted,
+            "spec_acceptance_rate": round(
+                self.spec_accepted / self.spec_drafted, 4)
+            if self.spec_drafted else 0.0,
+            "draft_s": round(self.draft_s, 6),
             "occupancy_mean": round(
                 mean(occ) / self.n_slots if self.n_slots else 0.0, 4),
             # lag-harvested latency means + tails from the histograms'

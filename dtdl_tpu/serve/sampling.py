@@ -51,16 +51,16 @@ class SampleParams:
 GREEDY = SampleParams()
 
 
-def sample(logits, key, temperature, top_k, top_p):
-    """Sample one token per slot: [B, V] f32 logits -> [B] int32.
-
-    ``temperature``/``top_p`` are f32 [B], ``top_k`` int32 [B] — all
-    dynamic (see module docstring).  Rows whose temperature is 0 return
-    the raw argmax regardless of their top-k/top-p settings.
+def filter_logits(logits, temperature, top_k, top_p):
+    """Scale + truncate [B, V] f32 logits per slot: the masked logits
+    whose softmax is the slot's TARGET distribution (temperature > 0
+    rows; greedy rows are handled by the callers via raw argmax).
+    Shared by :func:`sample` (one draw) and :func:`accept_resample`
+    (speculative accept/residual draws) so both paths sample the exact
+    same distribution — the losslessness of spec decode reduces to this
+    sharing.
     """
     _, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     order = jnp.argsort(-scaled, axis=-1)                    # [B, V] desc
     sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
@@ -80,9 +80,99 @@ def sample(logits, key, temperature, top_k, top_p):
     inv = jnp.argsort(order, axis=-1)
     keep_p = jnp.take_along_axis(keep_sorted, inv, axis=-1)
 
-    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    return jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+
+
+def sample(logits, key, temperature, top_k, top_p):
+    """Sample one token per slot: [B, V] f32 logits -> [B] int32.
+
+    ``temperature``/``top_p`` are f32 [B], ``top_k`` int32 [B] — all
+    dynamic (see module docstring).  Rows whose temperature is 0 return
+    the raw argmax regardless of their top-k/top-p settings.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = filter_logits(logits, temperature, top_k, top_p)
     drawn = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, drawn)
+
+
+def accept_resample(logits, draft, draft_len, key, temperature, top_k,
+                    top_p):
+    """The speculative-decoding accept/resample kernel — ON DEVICE,
+    per slot, provably lossless.
+
+    ``logits`` [B, k+1, V] f32: position i's next-token logits after
+    feeding the slot's last committed token then draft tokens 1..i (the
+    verify pass, models/transformer.py:_verify_attend_slots).  ``draft``
+    [B, k] int32 candidates, of which only the first ``draft_len[b]``
+    are real (the rest are padding — auto-rejected).  Returns
+    ``(tokens [B, k+1] int32, n_accepted [B] int32)``: tokens[b, :n+1]
+    are the slot's emitted tokens this step — the n accepted drafts plus
+    one final token — and everything past that is zero padding.
+
+    Acceptance per draft position i (all slots in one fused pass):
+
+    * **greedy rows** (temperature 0): accept iff ``draft[b, i]`` equals
+      the raw argmax — the longest matching prefix, so the emitted
+      tokens are exactly what i+1 sequential greedy decodes produce
+      (token identity, the tests/test_spec_decode.py contract).
+    * **sampling rows**: the draft is treated as a *deterministic*
+      proposal (one-hot q), so accept with probability ``p_i(draft_i)``
+      under the slot's full temperature/top-k/top-p target distribution
+      ``p_i`` (:func:`filter_logits` — the same masked logits
+      :func:`sample` draws from).  On the first rejection the final
+      token is drawn from the **residual** ``max(0, p - q)`` renormalized
+      — for one-hot q that is p with the rejected token zeroed out.
+      P(emit t) = p(d)·1[t=d] + (1-p(d))·p(t)·1[t≠d]/(1-p(d)) = p(t):
+      the emitted token is distributed EXACTLY as a plain sample from p,
+      whatever the draft source proposed (Leviathan et al. 2023, the
+      one-hot-proposal special case).  If every real draft is accepted
+      the final token is a normal sample from ``p_{draft_len}`` (the
+      bonus token — conditioning on all accepted drafts).
+    """
+    B, k1, V = logits.shape
+    k = k1 - 1
+    greedy_row = temperature <= 0.0                          # [B]
+    argmaxes = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+    filt = jax.vmap(
+        lambda lg: filter_logits(lg, temperature, top_k, top_p),
+        in_axes=1, out_axes=1)(logits)                       # [B, k+1, V]
+    probs = jax.nn.softmax(filt, axis=-1)
+
+    key_u, key_f = jax.random.split(key)
+    u = jax.random.uniform(key_u, (B, k))
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], draft[..., None], axis=-1)[..., 0]     # [B, k]
+    acc = jnp.where(greedy_row[:, None], draft == argmaxes[:, :k],
+                    u < p_draft)
+    acc = acc & (jnp.arange(k)[None, :] < draft_len[:, None])
+    # longest accepted prefix: cumprod zeroes everything after the first
+    # rejection
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+
+    # final token at position n_acc: raw argmax for greedy rows (== the
+    # token sequential decode would emit there); residual/bonus draw for
+    # sampling rows
+    fin_raw = jnp.take_along_axis(
+        logits, n_acc[:, None, None], axis=1)[:, 0]          # [B, V]
+    fin_filt = jnp.take_along_axis(
+        filt, n_acc[:, None, None], axis=1)[:, 0]
+    rejected = n_acc < draft_len           # a REAL draft was refused here
+    d_rej = jnp.take_along_axis(
+        draft, jnp.minimum(n_acc, k - 1)[:, None], axis=1)[:, 0]
+    residual = jnp.where(
+        rejected[:, None] & (jnp.arange(V)[None, :] == d_rej[:, None]),
+        -jnp.inf, fin_filt)
+    drawn = jax.random.categorical(key_f, residual,
+                                   axis=-1).astype(jnp.int32)
+    fin = jnp.where(greedy_row,
+                    jnp.argmax(fin_raw, axis=-1).astype(jnp.int32), drawn)
+
+    pos_i = jnp.arange(k1)[None, :]
+    tokens = jnp.where(pos_i < n_acc[:, None],
+                       jnp.pad(draft, ((0, 0), (0, 1))), 0)
+    tokens = jnp.where(pos_i == n_acc[:, None], fin[:, None], tokens)
+    return tokens.astype(jnp.int32), n_acc.astype(jnp.int32)
 
 
 def pack(params_per_slot) -> tuple:

@@ -1,29 +1,61 @@
 """Slot-based continuous batcher over the InferenceEngine.
 
 Orca-style iteration-level scheduling on fixed XLA shapes: the engine's
-decode program always steps all ``n_slots`` arena rows; this module
-decides *what occupies the rows*.  A request is admitted into the first
-free slot (one bucketed prefill), decodes in lockstep with whatever else
-is in flight, and retires the moment its budget is exhausted — freeing
-the row for the next queued request **mid-flight**, while the other
-slots keep decoding.  Short requests never wait for long ones and the
-batch never pads to the longest request; the only granularity is one
-decode step.
+programs always step all ``n_slots`` arena rows; this module decides
+*what occupies the rows*.  A request is admitted into the first free
+slot (one bucketed prefill), decodes in lockstep with whatever else is
+in flight, and retires the moment its budget is exhausted — freeing the
+row for the next queued request **mid-flight**, while the other slots
+keep decoding.  Short requests never wait for long ones and the batch
+never pads to the longest request; the only granularity is one step.
 
 Dispatch discipline (PR 1, SCALING.md "Async dispatch discipline"): the
 loop never reads a device value it just dispatched.  The decode feedback
 path — sampled token back in as next input — stays ON DEVICE via the
 ``last_tokens`` vector, so back-to-back steps pipeline without any
 host↔device round-trip.  Host-side bookkeeping uses only what the host
-already knows at dispatch time (slot occupancy, per-request token
-budgets).  Sampled tokens reach the host through a **lag harvest**: each
-step's token vector enters a bounded queue and is converted
-``harvest_lag`` steps later, when the device has long finished (the same
-backpressure shape as metrics.MetricsQueue).  The one consequence: EOS
-detection is late by up to ``harvest_lag`` steps, so a slot decodes up
-to that many garbage tokens past its stop token before retiring — they
-are trimmed from the output at harvest.  ``harvest_lag=0`` restores
-sync-every-step EOS exactness at sync-every-step cost.
+already knows at dispatch time.  Sampled tokens reach the host through a
+**lag harvest**: each step's token window enters a bounded queue and is
+converted ``harvest_lag`` steps later, when the device has long finished
+(the same backpressure shape as metrics.MetricsQueue).  The one
+consequence: EOS detection is late by up to ``harvest_lag`` steps, so a
+slot decodes up to that many garbage steps past its stop token before
+retiring — they are trimmed from the output at harvest.
+``harvest_lag=0`` restores sync-every-step EOS exactness at
+sync-every-step cost.
+
+**Speculative decoding** rides the same discipline.  A request with
+``speculate=k > 0`` gets per-step drafts from a host-side
+:class:`~dtdl_tpu.serve.draft.DraftSource` — chosen from *lag-harvested
+host state* (the source predicts ``gap + k`` tokens continuing the
+harvested truth and the optimistic in-flight ``gap`` is skipped — see
+``_make_drafts``), never by syncing the in-flight step —
+and the engine's ``verify`` program scores all candidates in one
+parameter sweep, accepting a per-slot prefix ON DEVICE
+(serve/sampling.py:accept_resample, lossless).  Consequences the
+scheduler absorbs:
+
+* **variable tokens per step** — a verify step emits 1..k+1 tokens per
+  slot, known only on device, so pending entries carry a token *window*
+  plus per-slot counts; budget and EOS checks run over the harvested
+  window (EOS mid-window trims exactly, as in the plain path).
+* **retirement on guaranteed progress** — the host can no longer count
+  emitted tokens at dispatch; every step guarantees >= 1 token, so a
+  slot retires when its guaranteed count reaches its budget (for
+  non-speculative slots this is exactly the old dispatched count).
+  Accepted tokens beyond the budget are trimmed at harvest.
+* **worst-case index tracking** — verify writes a k+1-token window at
+  the slot's cache position, so the scheduler tracks each slot's
+  worst-case (all-accepted) index and, within k of ``max_seq``, settles
+  in-flight steps before dispatching (the only data-dependent syncs, and
+  only ever in the last k positions of a sequence).
+* **adaptive draft length** — each slot tracks a trailing-acceptance
+  EMA and halves/doubles its draft length k accordingly; the step's
+  width is the power-of-two bucket of the largest per-slot k, so mixed
+  spec/non-spec traffic shares one verify program per bucket
+  (non-speculative slots ride along with ``draft_len=0`` and behave
+  exactly like a decode step — token-identical, pinned by
+  tests/test_spec_decode.py).
 """
 
 from __future__ import annotations
@@ -38,7 +70,8 @@ import jax
 import numpy as np
 
 from dtdl_tpu.obs.observer import NULL_OBSERVER
-from dtdl_tpu.serve.engine import InferenceEngine
+from dtdl_tpu.serve.draft import DraftSource, NGramDraft
+from dtdl_tpu.serve.engine import InferenceEngine, PromptTooLongError
 from dtdl_tpu.serve.metrics import ServeMetrics
 from dtdl_tpu.serve.sampling import GREEDY, SampleParams
 
@@ -51,14 +84,20 @@ class Request:
 
     ``tokens`` fills with the generated tokens (eos included, post-eos
     trimmed) as they harvest; ``done`` flips when the last one lands.
+    ``speculate`` is the request's maximum draft length (0 = plain
+    decode); ``error`` is set instead of raising when the scheduler
+    rejects the request at submit (e.g. prompt longer than the engine's
+    largest prefill bucket).
     """
     prompt: Sequence[int]
     max_new_tokens: int
     sampling: SampleParams = GREEDY
     eos_id: Optional[int] = None
+    speculate: int = 0
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
+    error: Optional[str] = None
     # wall-clock lifecycle (host side; first/done are harvest times, i.e.
     # when the host could actually observe the token)
     t_submit: float = 0.0
@@ -66,36 +105,114 @@ class Request:
     t_first: float = 0.0
     t_done: float = 0.0
     admit_step: int = -1
-    # internal: tokens dispatched / slot retired (budget exhausted)
-    _dispatched: int = dataclasses.field(default=0, repr=False)
+    # internal: tokens guaranteed emitted by dispatched steps (>= 1 per
+    # step; exact for non-speculative slots) / slot retired
+    _guaranteed: int = dataclasses.field(default=0, repr=False)
     _retired: bool = dataclasses.field(default=False, repr=False)
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{self.max_new_tokens}")
+        if self.speculate < 0:
+            raise ValueError(f"speculate must be >= 0, got "
+                             f"{self.speculate}")
+
+
+class _SlotState:
+    """Host-side per-slot tracking while a request occupies the row.
+
+    ``pos`` is the slot's cache index as of the last *harvested* step
+    (exact); ``inflight`` holds each dispatched-but-unharvested step's
+    draft length, so ``pos_hi`` bounds the device index from above (the
+    all-accepted worst case) and ``gap`` is the optimistic number of
+    tokens the device is ahead of the harvested truth — the draft
+    source predicts *across* that gap fresh every step, so a
+    misprediction self-heals at the next harvest instead of poisoning
+    later drafts.  ``k_cur`` is the adaptive draft length, steered by a
+    trailing-acceptance EMA.
+    """
+
+    __slots__ = ("rid", "pos", "k_cur", "k_max", "acc_ema", "inflight")
+
+    def __init__(self, rid: int, pos: int, k_max: int):
+        self.rid = rid
+        self.pos = pos
+        self.k_max = k_max
+        # start at 2 and let the acceptance EMA steer: doubles under
+        # sustained acceptance (>0.8) up to the request's ``speculate``,
+        # halves under <0.5 — so a weak draft source costs at most a few
+        # over-drafted steps before settling at k=1
+        self.k_cur = max(1, min(2, k_max))
+        self.acc_ema = 1.0          # optimistic until measured
+        self.inflight: deque = deque()
+
+    @property
+    def pos_hi(self) -> int:
+        """Worst-case (all-accepted) device index — the overflow bound."""
+        return self.pos + sum(dl + 1 for dl in self.inflight)
+
+    @property
+    def gap_est(self) -> int:
+        """EXPECTED tokens the device is ahead of harvested truth: one
+        guaranteed per in-flight step plus acceptance-EMA-weighted
+        drafts.  At high acceptance this is the all-accepted count
+        (aligned drafting, the payoff regime); at low acceptance it
+        decays to one-per-step, which is what the device is actually
+        doing — either way the skip stays close to the true offset."""
+        a = min(1.0, max(0.0, self.acc_ema))
+        return sum(1 + int(round(dl * a)) for dl in self.inflight)
+
+    def dispatched(self, draft_len: int) -> None:
+        self.inflight.append(draft_len)
+
+    def settle(self, draft_len: int, n_emitted: int) -> None:
+        """One in-flight step harvested: exact index, acceptance EMA,
+        and the multiplicative k adaptation (halve under ~50%% trailing
+        acceptance, double — up to the request's ``speculate`` — above
+        ~80%%)."""
+        if self.inflight:
+            self.inflight.popleft()
+        self.pos += n_emitted
+        if draft_len > 0:
+            rate = (n_emitted - 1) / draft_len
+            self.acc_ema = 0.5 * self.acc_ema + 0.5 * rate
+            if self.acc_ema < 0.5:
+                self.k_cur = max(1, self.k_cur // 2)
+            elif self.acc_ema > 0.8:
+                self.k_cur = min(max(1, self.k_cur * 2), self.k_max)
 
 
 class Scheduler:
     """Continuous batcher (see module docstring).
 
-    ``submit`` enqueues; ``step`` runs one admit+decode round; ``run``
-    drives until everything submitted has finished and returns the
-    finished requests in completion order.
+    ``submit`` enqueues (or rejects — see :class:`Request` ``error``);
+    ``step`` runs one admit+draft+decode/verify round; ``run`` drives
+    until everything submitted has finished and returns the finished
+    requests in completion order.  ``draft`` is the
+    :class:`~dtdl_tpu.serve.draft.DraftSource` used for requests with
+    ``speculate > 0`` (default: device-free n-gram prompt lookup).
     """
 
     def __init__(self, engine: InferenceEngine, seed: int = 0,
                  harvest_lag: int = 4, metrics: ServeMetrics = None,
-                 observer=None):
+                 observer=None, draft: Optional[DraftSource] = None):
         if harvest_lag < 0:
             raise ValueError(f"harvest_lag must be >= 0, got "
                              f"{harvest_lag}")
-        # obs facade: thread-safe spans (admit/dispatch/harvest) + the
-        # engine's recompile sentinel; defaults to all-no-ops
+        # obs facade: thread-safe spans (admit/draft/dispatch/verify/
+        # harvest) + the engine's recompile sentinel; defaults to no-ops
         self.observer = observer or NULL_OBSERVER
         if observer is not None and engine.observer is None:
-            engine.observer = observer   # sentinel on prefill/decode jits
+            engine.observer = observer   # sentinel on the engine's jits
         self.engine = engine
+        self.draft = draft if draft is not None else NGramDraft()
+        draft_model = getattr(self.draft, "model", None)
+        if draft_model is not None and \
+                draft_model.vocab_size != engine.model.vocab_size:
+            raise ValueError(
+                f"draft model vocab ({draft_model.vocab_size}) must match "
+                f"the served model's ({engine.model.vocab_size})")
         self.arena = engine.init_arena()
         self.last_tokens = engine.init_last_tokens()
         self.queue: deque[Request] = deque()
@@ -105,29 +222,38 @@ class Scheduler:
         self.finished: list[Request] = []
         self._reqs: dict[int, Request] = {}
         self._active = np.zeros(engine.n_slots, bool)
+        self._state: list[Optional[_SlotState]] = [None] * engine.n_slots
         self._temp = np.zeros(engine.n_slots, np.float32)
         self._topk = np.zeros(engine.n_slots, np.int32)
         self._topp = np.ones(engine.n_slots, np.float32)
         self._key = jax.random.PRNGKey(seed)
-        # lag harvest: (token_vector_device, ((slot, rid, gen_idx), ...))
-        self._pending: deque[tuple[Any, tuple]] = deque()
+        # lag harvest: (token window [B] or [B, k+1], per-slot counts or
+        # None (=1 each), ((slot, rid, draft_len), ...))
+        self._pending: deque[tuple[Any, Any, tuple]] = deque()
         self.step_count = 0
 
     # ---- intake -------------------------------------------------------
 
     def submit(self, req: Request) -> Request:
-        # full admission validation HERE: a bad request rejected at
-        # admit time would already be popped from the queue and would
-        # strand every other in-flight request mid-run
+        """Enqueue ``req``; a prompt the engine cannot prefill comes back
+        as a *rejected* request (``req.error`` set, ``req.done`` True,
+        counted in ``requests_rejected``) instead of raising — one
+        oversized prompt must not crash a run with other requests in
+        flight."""
         prompt_len = len(req.prompt)
         if prompt_len < 1:
             raise ValueError("empty prompt")
-        if prompt_len > self.engine.buckets[-1]:
-            raise ValueError(
-                f"prompt length {prompt_len} exceeds the largest "
-                f"prefill bucket {self.engine.buckets[-1]} "
-                f"(max_seq={self.engine.max_seq})")
         req.t_submit = time.perf_counter()
+        try:
+            self.engine.bucket_for(prompt_len)
+        except PromptTooLongError as e:
+            req.error = str(e)
+            req.done = True
+            req.t_done = req.t_submit
+            self._reqs[req.rid] = req
+            self.finished.append(req)
+            self.metrics.on_reject(req)
+            return req
         self._reqs[req.rid] = req
         self.queue.append(req)
         self.metrics.on_submit(req)
@@ -162,40 +288,140 @@ class Scheduler:
                 self._next_key())
             self.slots[slot] = req
             self._active[slot] = True
+            self._state[slot] = _SlotState(req.rid, len(req.prompt),
+                                           req.speculate)
             self._temp[slot] = sp.temperature
             self._topk[slot] = sp.top_k
             self._topp[slot] = sp.top_p
             req.t_admit = time.perf_counter()
             req.admit_step = self.step_count
-            req._dispatched = 1
+            req._guaranteed = 1
+            self._state[slot].dispatched(0)
             self._pending.append(
-                (self.last_tokens, ((slot, req.rid, 0),)))
+                (self.last_tokens, None, ((slot, req.rid, 0),)))
             self.metrics.on_admit(req, slot, len(req.prompt))
-            if req._dispatched >= self._budget(req):
+            if req._guaranteed >= self._budget(req):
                 self._retire(slot)
+
+    # ---- drafting -----------------------------------------------------
+
+    def _make_drafts(self):
+        """Choose this step's draft width and per-slot draft tokens.
+
+        Returns ``(k_prog, drafts [B, k_prog], draft_lens [B])`` with
+        ``k_prog == 0`` meaning "plain decode step".  ``k_prog`` is the
+        power-of-two bucket of the largest per-slot adaptive k, clamped
+        so every active slot has room for the full k_prog+1 write window
+        (``pos_hi + k_prog < max_seq``) — one compiled verify program
+        per bucket, shared by mixed spec/non-spec traffic.
+
+        Drafting under lag: the device is up to ``gap`` tokens ahead of
+        the harvested truth, so the source is asked for ``gap + k``
+        tokens continuing the TRUTH and the first ``gap`` (its guess of
+        the in-flight tokens, assuming all drafts accepted) are skipped.
+        A wrong guess costs one rejected window and heals at the next
+        harvest — predicting the gap fresh each step is what keeps a
+        single misprediction from poisoning every later draft.  With
+        ``harvest_lag=0`` the gap is 0 and drafting conditions on exact
+        state.
+        """
+        B = self.engine.n_slots
+        max_seq = self.engine.max_seq
+        desires = {}
+        k_room = None
+        for slot, req in enumerate(self.slots):
+            if not self._active[slot]:
+                continue
+            st = self._state[slot]
+            room = max_seq - 1 - st.pos_hi
+            k_room = room if k_room is None else min(k_room, room)
+            if not req.speculate:
+                continue
+            remaining = self._budget(req) - req._guaranteed
+            des = min(st.k_cur, req.speculate, remaining - 1, room)
+            if des > 0:
+                desires[slot] = des
+        if not desires or k_room < 1:
+            return 0, None, None
+        k_prog = 1
+        while k_prog < max(desires.values()):
+            k_prog *= 2
+        while k_prog > k_room and k_prog > 1:
+            k_prog //= 2
+        drafts = np.zeros((B, k_prog), np.int32)
+        lens = np.zeros(B, np.int32)
+        n_drafted = 0
+        for slot, des in desires.items():
+            req, st = self.slots[slot], self._state[slot]
+            want = min(des, k_prog)
+            gap = st.gap_est
+            ctx = np.asarray(list(req.prompt) + req.tokens, np.int32)
+            pred = np.asarray(self.draft.propose(ctx, gap + want),
+                              np.int32)
+            cand = pred[gap:gap + want]          # skip the in-flight gap
+            dl = int(cand.size)
+            drafts[slot, :dl] = cand
+            lens[slot] = dl
+            n_drafted += dl
+        if n_drafted == 0:
+            return 0, None, None
+        return k_prog, drafts, lens
 
     # ---- the decode round --------------------------------------------
 
     def step(self) -> int:
-        """One admit + decode round; returns how many slots decoded."""
+        """One admit + draft + decode/verify round; returns how many
+        slots stepped."""
         with self.observer.span("admit"):
             self._admit()
+        # overflow settling: a speculative slot's worst-case index may
+        # not leave room to write even one token — settle in-flight
+        # steps until it does (only ever within k of max_seq)
+        while self._pending and any(
+                self._state[s].pos_hi > self.engine.max_seq - 1
+                for s in range(self.engine.n_slots) if self._active[s]):
+            with self.observer.span("harvest", forced=1):
+                self._harvest_one()
         n_active = int(self._active.sum())
         if n_active:
-            entries = []
-            for slot, req in enumerate(self.slots):
-                if self._active[slot]:
-                    entries.append((slot, req.rid, req._dispatched))
-            with self.observer.span("dispatch", n_active=n_active):
-                self.arena, self.last_tokens, _ = self.engine.decode(
-                    self.arena, self.last_tokens, self._active,
-                    self._next_key(), self._temp, self._topk, self._topp)
-            self._pending.append((self.last_tokens, tuple(entries)))
-            for slot, req in enumerate(self.slots):
-                if self._active[slot]:
-                    req._dispatched += 1
-                    if req._dispatched >= self._budget(req):
-                        self._retire(slot)
+            t_draft = time.perf_counter()
+            with self.observer.span("draft", n_active=n_active):
+                k_prog, drafts, lens = self._make_drafts()
+            self.metrics.on_draft(time.perf_counter() - t_draft)
+            if k_prog > 0:
+                entries = tuple(
+                    (slot, req.rid, int(lens[slot]))
+                    for slot, req in enumerate(self.slots)
+                    if self._active[slot])
+                with self.observer.span("verify", n_active=n_active,
+                                        k=k_prog):
+                    (self.arena, self.last_tokens, window,
+                     counts) = self.engine.verify(
+                        self.arena, self.last_tokens, drafts, lens,
+                        self._active, self._next_key(), self._temp,
+                        self._topk, self._topp)
+                self._pending.append((window, counts, entries))
+                self.metrics.on_verify(k_prog)
+                for slot, rid, dl in entries:
+                    self._state[slot].dispatched(dl)
+            else:
+                entries = tuple(
+                    (slot, req.rid, 0)
+                    for slot, req in enumerate(self.slots)
+                    if self._active[slot])
+                with self.observer.span("dispatch", n_active=n_active):
+                    self.arena, self.last_tokens, _ = self.engine.decode(
+                        self.arena, self.last_tokens, self._active,
+                        self._next_key(), self._temp, self._topk,
+                        self._topp)
+                self._pending.append((self.last_tokens, None, entries))
+                for slot, rid, _ in entries:
+                    self._state[slot].dispatched(0)
+            for slot, rid, _ in entries:
+                req = self.slots[slot]
+                req._guaranteed += 1
+                if req._guaranteed >= self._budget(req):
+                    self._retire(slot)
         self.step_count += 1
         self.metrics.on_step(n_active, self.engine.n_slots)
         if len(self._pending) > self.harvest_lag:
@@ -207,28 +433,44 @@ class Scheduler:
     # ---- harvest ------------------------------------------------------
 
     def _harvest_one(self):
-        vec, entries = self._pending.popleft()
-        arr = np.asarray(vec)   # blocks only until THIS (lagged) step
+        window, counts, entries = self._pending.popleft()
+        arr = np.asarray(window)  # blocks only until THIS (lagged) step
+        cnt = np.asarray(counts) if counts is not None else None
         now = time.perf_counter()
-        for slot, rid, gen_idx in entries:
+        for slot, rid, dl in entries:
             req = self._reqs[rid]
-            if req.done:         # post-eos garbage from the lag window
-                continue
-            req.tokens.append(int(arr[slot]))
-            if gen_idx == 0:
-                req.t_first = now
-                self.metrics.on_first_token(req)
-            hit_eos = (req.eos_id is not None
-                       and req.tokens[-1] == req.eos_id)
-            if hit_eos and self.slots[slot] is req:
-                # EOS observed `lag` steps after dispatch: stop decoding
+            n_em = int(cnt[slot]) if cnt is not None else 1
+            toks = arr[slot, :n_em] if arr.ndim == 2 else arr[slot:slot+1]
+            st = self._state[slot]
+            if st is not None and st.rid == rid:
+                st.settle(dl, n_em)
+            if dl:
+                self.metrics.on_spec_harvest(dl, n_em - 1)
+            if req.done:         # post-eos/budget garbage from the lag
+                continue         # window (or spec overshoot)
+            budget = self._budget(req)
+            first_window = len(req.tokens) == 0
+            delivered = 0
+            for t in toks:
+                req.tokens.append(int(t))
+                delivered += 1
+                if len(req.tokens) == 1:
+                    req.t_first = now
+                    self.metrics.on_first_token(req)
+                hit_eos = (req.eos_id is not None
+                           and req.tokens[-1] == req.eos_id)
+                if hit_eos or len(req.tokens) >= budget:
+                    req.done = True
+                    req.t_done = now
+                    self.finished.append(req)
+                    self.metrics.on_finish(req)
+                    break        # EOS mid-window trims exactly
+            # decode-token accounting counts DELIVERED generated tokens
+            # (the request's very first token is the prefill's)
+            self.metrics.on_harvest_tokens(
+                delivered - (1 if first_window and delivered else 0))
+            if req.done and self.slots[slot] is req:
                 self._retire(slot)
-            if hit_eos or (req._retired
-                           and len(req.tokens) >= req._dispatched):
-                req.done = True
-                req.t_done = now
-                self.finished.append(req)
-                self.metrics.on_finish(req)
 
     def drain(self):
         """Harvest everything still in flight (the boundary sync)."""

@@ -44,6 +44,12 @@ def main():
     flag(parser, "--harvest-lag", type=int, default=4,
          help="steps a sampled token may stay device-side before the "
               "host reads it (0 = sync every step)")
+    flag(parser, "--speculate", type=int, default=0,
+         help="speculative decoding: max drafted tokens per step "
+              "(0 = off; lossless — greedy output is token-identical)")
+    flag(parser, "--draft", default="ngram", choices=["ngram", "model"],
+         help="draft source for --speculate: device-free n-gram prompt "
+              "lookup, or a small draft transformer sharing the vocab")
     flag(parser, "--seed", type=int, default=0)
     flag(parser, "--trace", default="",
          help="write a Chrome-trace-event JSON (Perfetto-loadable) of "
@@ -67,8 +73,19 @@ def main():
     obs = Observer(trace_path=args.trace or None, sentinel="warn")
     engine = InferenceEngine(model, params, n_slots=args.n_slots,
                              observer=obs)
+    draft = None
+    if args.speculate and args.draft == "model":
+        # demo draft transformer: a narrower random-init LM sharing the
+        # vocab (real deployments restore trained draft weights)
+        from dtdl_tpu.serve import ModelDraft
+        dm = transformer_lm("tiny", vocab_size=model.vocab_size,
+                            attn_impl="dense", dtype=jnp.float32)
+        dp = nn.unbox(dm.init(jax.random.PRNGKey(args.seed + 1),
+                              example)["params"])
+        draft = ModelDraft(dm, dp)
     sched = Scheduler(engine, seed=args.seed,
-                      harvest_lag=args.harvest_lag, observer=obs)
+                      harvest_lag=args.harvest_lag, observer=obs,
+                      draft=draft)
     sp = SampleParams(temperature=args.temperature, top_k=args.top_k,
                       top_p=args.top_p)
 
@@ -77,7 +94,8 @@ def main():
     lens = rng.integers(4, min(64, model.max_seq // 2),
                         args.n_requests)
     reqs = [Request(rng.integers(0, model.vocab_size, n).tolist(),
-                    args.max_new_tokens, sampling=sp) for n in lens]
+                    args.max_new_tokens, sampling=sp,
+                    speculate=args.speculate) for n in lens]
 
     t0 = time.perf_counter()
     sched.run(reqs)
@@ -95,6 +113,20 @@ def main():
               f"   per-token p50/p99: "
               f"{s.get('tok_latency_s_p50', 0.0) * 1e3:.2f} / "
               f"{s.get('tok_latency_s_p99', 0.0) * 1e3:.2f} ms")
+    if args.speculate:
+        # per-request ACCEPTED tokens/sec (delivered tokens over the
+        # request's own decode window) — the user-visible spec win
+        rates = sorted((len(r.tokens) - 1) / (r.t_done - r.t_first)
+                       for r in reqs
+                       if len(r.tokens) > 1 and r.t_done > r.t_first)
+        pct = (lambda p: rates[min(len(rates) - 1,
+                                   int(p * (len(rates) - 1)))]) \
+            if rates else (lambda p: 0.0)
+        print(f"  speculative k<={args.speculate} ({args.draft}): "
+              f"acceptance {s['spec_acceptance_rate']:.0%}  "
+              f"tokens/step {s['tokens_per_step_mean']:.2f}  "
+              f"accepted-tok/s p50/p95: {pct(0.5):.1f} / {pct(0.95):.1f}  "
+              f"draft overhead {s['draft_s'] * 1e3:.1f}ms")
     print("compiled programs:", engine.compile_stats())
     if args.trace:
         print(f"trace written to {obs.save()}", flush=True)

@@ -22,7 +22,8 @@ from dtdl_tpu.models.transformer import (
     CacheOverflowError, cache_max_seq, transformer_lm,
 )
 from dtdl_tpu.serve import (
-    InferenceEngine, Request, SampleParams, Scheduler, sample,
+    InferenceEngine, PromptTooLongError, Request, SampleParams, Scheduler,
+    sample,
 )
 
 MAX_SEQ = 48
@@ -240,8 +241,18 @@ def test_engine_rejects_bad_inputs(engine):
     # reach admission (where it would strand the other in-flight requests)
     with pytest.raises(ValueError, match="empty"):
         Scheduler(engine).submit(Request([], 1))
-    with pytest.raises(ValueError, match="bucket"):
-        Scheduler(engine).submit(Request(list(range(BUCKETS[-1] + 1)), 1))
+    # an oversized prompt is a *data* problem, not a caller bug: it comes
+    # back rejected (error set, never queued) instead of crashing a run
+    # with other requests in flight — the engine's named error carries
+    # the configured bucket list
+    sched = Scheduler(engine)
+    bad = sched.submit(Request(list(range(BUCKETS[-1] + 1)), 1))
+    assert bad.done and bad.error is not None
+    assert "bucket" in bad.error and str(BUCKETS) in bad.error
+    assert not sched.queue and bad in sched.finished
+    assert sched.metrics.summary()["requests_rejected"] == 1
+    with pytest.raises(PromptTooLongError, match="bucket"):
+        engine.bucket_for(BUCKETS[-1] + 1)
     with pytest.raises(ValueError, match="empty"):
         engine.prefill(engine.init_arena(), engine.init_last_tokens(),
                        0, [])

@@ -1,0 +1,323 @@
+"""Speculative decoding: losslessness, compile counts, analytic acceptance.
+
+The ISSUE-4 contracts, on the same tiny f32 dense config as
+tests/test_serve.py:
+
+* **token identity** — greedy speculative decoding produces, per
+  request, EXACTLY the tokens the non-speculative engine produces —
+  mixed-length batches, mixed spec/non-spec traffic, mid-flight
+  admission, and even adversarially wrong drafts (losslessness must not
+  depend on draft quality);
+* **compile counts** — exactly one verify program per draft-width
+  bucket, zero steady-state recompiles across mixed sampling configs
+  (pinned via the PR-3 RecompileSentinel at policy='raise');
+* **analytic acceptance** — the rejection-sampling kernel accepts a
+  drafted token with probability p(token) under the target distribution
+  and emits tokens distributed exactly as p, checked on a
+  hand-computable 4-token vocab.
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from dtdl_tpu.models.transformer import transformer_lm
+from dtdl_tpu.obs import Observer
+from dtdl_tpu.serve import (
+    InferenceEngine, ModelDraft, NGramDraft, Request, SampleParams,
+    Scheduler, accept_resample,
+)
+
+MAX_SEQ = 48
+BUCKETS = (8, 16)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, attn_impl="dense", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return nn.unbox(model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))["params"])
+
+
+@pytest.fixture(scope="module")
+def engine(model, params):
+    return InferenceEngine(model, params, n_slots=2, buckets=BUCKETS)
+
+
+def _nonspec_tokens(engine, prompts, n_new):
+    reqs = [Request(p, n) for p, n in zip(prompts, n_new)]
+    Scheduler(engine, harvest_lag=3).run(reqs)
+    return [r.tokens for r in reqs]
+
+
+class OracleDraft:
+    """Drafts from the known full sequences (prompt-prefix keyed) — the
+    perfect source, for pinning the all-accepted fast path."""
+
+    def __init__(self, prompts, token_lists):
+        self.seqs = [(list(p), list(p) + list(t))
+                     for p, t in zip(prompts, token_lists)]
+
+    def propose(self, ctx, k):
+        ctx = list(np.asarray(ctx, np.int32))
+        for p, full in self.seqs:
+            if ctx[:len(p)] == p and ctx == full[:len(ctx)]:
+                return np.asarray(full[len(ctx):len(ctx) + k], np.int32)
+        return np.zeros((0,), np.int32)
+
+
+class GarbageDraft:
+    """Always drafts the same (almost always wrong) token — the
+    adversarial source: every draft rejected, output must not change."""
+
+    def propose(self, ctx, k):
+        return np.full((k,), 63, np.int32)
+
+
+def test_greedy_spec_token_identical_mixed_traffic(engine):
+    """THE spec pin: mixed-length prompts through 2 slots with slot
+    reuse and mid-flight admission, a mix of speculate=4 and plain
+    requests, n-gram drafting — every request's tokens == the
+    non-speculative engine's."""
+    gen = np.random.default_rng(1)
+    lens = (3, 9, 14, 5, 7)
+    n_new = (12, 10, 14, 9, 11)
+    prompts = [gen.integers(0, 64, n).tolist() for n in lens]
+    ref = _nonspec_tokens(engine, prompts, n_new)
+
+    reqs = [Request(p, n, speculate=(4 if i % 2 == 0 else 0))
+            for i, (p, n) in enumerate(zip(prompts, n_new))]
+    sched = Scheduler(engine, harvest_lag=3, draft=NGramDraft())
+    sched.run(reqs)
+    for req, want in zip(reqs, ref):
+        assert req.done and req.tokens == want, \
+            f"rid={req.rid} diverged under speculation"
+    s = sched.metrics.summary()
+    assert s["spec_steps"] > 0 and s["spec_drafted_tokens"] > 0
+    # delivered-token accounting: decode_tokens counts every generated
+    # token exactly once, accepted or plainly decoded
+    assert s["decode_tokens"] == sum(len(t) for t in ref) - len(ref)
+
+
+def test_spec_lossless_under_garbage_drafts(model, params):
+    """An adversarial draft source (every candidate wrong) must cost
+    only throughput: output token-identical, acceptance ~0, and the
+    adaptive k collapses to 1."""
+    eng = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS)
+    gen = np.random.default_rng(2)
+    prompts = [gen.integers(0, 64, n).tolist() for n in (6, 11)]
+    ref = _nonspec_tokens(eng, prompts, (10, 10))
+
+    reqs = [Request(p, 10, speculate=4) for p in prompts]
+    sched = Scheduler(eng, harvest_lag=2, draft=GarbageDraft())
+    sched.run(reqs)
+    for req, want in zip(reqs, ref):
+        assert req.tokens == want
+    s = sched.metrics.summary()
+    assert s["spec_acceptance_rate"] < 0.2
+    # AIMD settled at k=1 (and never drafted wider than the start k=2)
+    assert set(eng.compile_stats()["verify"]) <= {1, 2}
+
+
+@pytest.mark.slow   # compiles a fresh engine's verify family (k=2,4,8)
+def test_oracle_draft_grows_k_and_accepts_everything(model, params):
+    """A perfect draft source: acceptance rate 1.0, the per-slot k
+    doubles from its start of 2 up to the request's speculate=8 (the
+    verify program family records the growth), and the output is still
+    token-identical."""
+    eng = InferenceEngine(model, params, n_slots=1, buckets=BUCKETS)
+    gen = np.random.default_rng(3)
+    prompt = gen.integers(0, 64, 5).tolist()
+    ref = _nonspec_tokens(eng, [prompt], (30,))[0]
+
+    req = Request(prompt, 30, speculate=8)
+    sched = Scheduler(eng, harvest_lag=2,
+                      draft=OracleDraft([prompt], [ref]))
+    sched.run([req])
+    assert req.tokens == ref
+    s = sched.metrics.summary()
+    assert s["spec_acceptance_rate"] == 1.0
+    assert 8 in eng.compile_stats()["verify"]          # k grew 2 -> 4 -> 8
+    assert s["tokens_per_step_mean"] > 2.0
+
+
+@pytest.mark.slow   # fresh engine: compiles 4 program families twice over
+def test_one_verify_program_per_k_bucket_no_recompiles(model, params):
+    """Compile receipts under spec traffic: one verify program per
+    touched draft-width bucket with jit cache size 1, and the
+    RecompileSentinel (policy='raise') sees zero genuine retraces
+    across mixed greedy/temperature/top-p sampling configs and two
+    scheduler generations over the same engine."""
+    eng = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS)
+    obs = Observer(sentinel="raise")
+    gen = np.random.default_rng(4)
+    sps = [SampleParams(), SampleParams(temperature=0.9, top_p=0.9),
+           SampleParams(temperature=0.7, top_k=8)]
+    for round_ in range(2):      # second scheduler must reuse everything
+        reqs = [Request(gen.integers(0, 64, n).tolist(), 8, speculate=4,
+                        sampling=sps[i % len(sps)])
+                for i, n in enumerate((3, 7, 12, 5))]
+        Scheduler(eng, harvest_lag=2, observer=obs,
+                  draft=NGramDraft()).run(reqs)
+        assert all(r.done for r in reqs)
+    stats = eng.compile_stats()
+    assert stats["decode"] <= 1
+    assert stats["verify"] and all(n == 1 for n in stats["verify"].values()), \
+        stats
+    assert all(n == 1 for n in stats["prefill"].values()), stats
+    assert obs.sentinel.summary()["recompile_events"] == 0
+
+
+def test_verify_emits_sequential_decode_tokens_per_window(engine):
+    """Direct engine-level pin of the verify window semantics: with
+    perfect drafts the window holds k accepted tokens + the bonus; with
+    a wrong first draft it holds exactly the one token plain decode
+    would have produced (n_accepted=0)."""
+    gen = np.random.default_rng(5)
+    p = gen.integers(0, 64, 6).tolist()
+    greedy = (jnp.zeros(2), jnp.zeros(2, jnp.int32), jnp.ones(2))
+    key = jax.random.PRNGKey(7)
+    active = np.array([True, False])
+
+    # sequential reference: prefill + 4 decode steps in slot 0
+    arena, last = engine.init_arena(), engine.init_last_tokens()
+    arena, last, _ = engine.prefill(arena, last, 0, p)
+    seq = [int(np.asarray(last)[0])]
+    for _ in range(4):
+        arena, last, _ = engine.decode(arena, last, active, key, *greedy)
+        seq.append(int(np.asarray(last)[0]))
+
+    # verify with the true continuation drafted: all accepted + bonus
+    arena, last = engine.init_arena(), engine.init_last_tokens()
+    arena, last, _ = engine.prefill(arena, last, 0, p)
+    drafts = np.zeros((2, 3), np.int32)
+    drafts[0] = seq[1:4]
+    arena, last, toks, n_em = engine.verify(
+        arena, last, drafts, np.array([3, 0]), active, key, *greedy)
+    toks, n_em = np.asarray(toks), np.asarray(n_em)
+    assert n_em[0] == 4 and n_em[1] == 0
+    assert toks[0, :4].tolist() == seq[1:5]
+
+    # same state, wrong first draft: exactly the plain-decode token
+    arena, last = engine.init_arena(), engine.init_last_tokens()
+    arena, last, _ = engine.prefill(arena, last, 0, p)
+    wrong = (np.asarray(drafts) + 1) % 64
+    arena, last, toks, n_em = engine.verify(
+        arena, last, wrong, np.array([3, 0]), active, key, *greedy)
+    toks, n_em = np.asarray(toks), np.asarray(n_em)
+    assert n_em[0] == 1 and toks[0, 0] == seq[1]
+
+
+def test_rejection_sampling_matches_analytic_acceptance():
+    """The hand-computable 4-token case: target p = softmax(logits),
+    one-hot proposal d.  Accept-rate must equal p[d] and the EMITTED
+    token distribution must equal p exactly (losslessness) — the
+    residual resample is what makes both true at once."""
+    logits_row = np.array([2.0, 1.0, 0.0, -1.0], np.float32)
+    p = np.exp(logits_row) / np.exp(logits_row).sum()
+    d = 1                                   # draft the second-best token
+    B = 4000
+    logits = jnp.asarray(np.tile(logits_row, (B, 2, 1)))  # [B, k+1=2, 4]
+    draft = jnp.full((B, 1), d, jnp.int32)
+    ones = jnp.ones(B)
+    toks, n_acc = accept_resample(
+        logits, draft, jnp.ones(B, jnp.int32), jax.random.PRNGKey(0),
+        ones, jnp.zeros(B, jnp.int32), ones)
+    toks, n_acc = np.asarray(toks), np.asarray(n_acc)
+
+    acc_rate = n_acc.mean()
+    se = np.sqrt(p[d] * (1 - p[d]) / B)
+    assert abs(acc_rate - p[d]) < 4 * se, (acc_rate, p[d])
+
+    # emitted first token ~ p exactly, accepted or resampled
+    emitted = toks[np.arange(B), 0]
+    freq = np.bincount(emitted, minlength=4) / B
+    np.testing.assert_allclose(freq, p, atol=4 * np.sqrt(0.25 / B) + 0.01)
+    # rejected rows resampled from the residual: never the drafted token
+    assert not np.any(emitted[n_acc == 0] == d)
+
+    # greedy rows: exact argmax prefix match only
+    toks_g, n_acc_g = accept_resample(
+        logits, draft, jnp.ones(B, jnp.int32), jax.random.PRNGKey(1),
+        jnp.zeros(B), jnp.zeros(B, jnp.int32), ones)
+    assert np.all(np.asarray(n_acc_g) == 0)          # argmax is token 0
+    assert np.all(np.asarray(toks_g)[:, 0] == 0)
+
+
+def test_spec_eos_trims_exactly(model, params, engine):
+    """EOS under speculation + lag harvest: accepted tokens past the
+    stop token (same window or later) are trimmed — identical output to
+    the non-speculative, lag-0 run."""
+    gen = np.random.default_rng(6)
+    prompt = gen.integers(0, 64, 5).tolist()
+    ref = _nonspec_tokens(engine, [prompt], (8,))[0]
+    eos = ref[2]                                     # stop 3 tokens in
+
+    for lag in (0, 3):
+        req = Request(prompt, 8, eos_id=eos, speculate=4)
+        Scheduler(engine, harvest_lag=lag, draft=NGramDraft()).run([req])
+        assert req.tokens == ref[:3], f"lag={lag}"
+
+
+def test_spec_budget_clamped_to_cache_capacity(engine):
+    """Speculative overshoot near max_seq: the worst-case index
+    settling keeps verify writes inside the arena and the request still
+    emits exactly its clamped budget."""
+    gen = np.random.default_rng(7)
+    prompt = gen.integers(0, 64, 14).tolist()
+    ref = _nonspec_tokens(engine, [prompt], (99,))[0]
+    req = Request(prompt, 99, speculate=4)
+    Scheduler(engine, harvest_lag=2, draft=NGramDraft()).run([req])
+    assert req.done
+    assert len(req.tokens) == MAX_SEQ - len(prompt) + 1
+    assert req.tokens == ref
+
+
+@pytest.mark.slow   # compiles generate() draft programs per (ctx, k)
+def test_model_draft_spec_identical(model, params):
+    """ModelDraft (a draft transformer sharing the vocab — here the
+    target itself over a truncated window, the degenerate but fully
+    exercising case): still token-identical greedy output."""
+    eng = InferenceEngine(model, params, n_slots=1, buckets=BUCKETS)
+    gen = np.random.default_rng(8)
+    prompt = gen.integers(0, 64, 6).tolist()
+    ref = _nonspec_tokens(eng, [prompt], (10,))[0]
+    req = Request(prompt, 10, speculate=2)
+    sched = Scheduler(eng, harvest_lag=1,
+                      draft=ModelDraft(model, params, window=8))
+    sched.run([req])
+    assert req.tokens == ref
+
+
+def test_model_draft_vocab_mismatch_rejected(model, params, engine):
+    other = transformer_lm("tiny", vocab_size=32, d_model=32, n_layers=1,
+                           n_heads=2, d_ff=64, max_seq=MAX_SEQ,
+                           attn_impl="dense", dtype=jnp.float32)
+    oparams = nn.unbox(other.init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 4), jnp.int32))["params"])
+    with pytest.raises(ValueError, match="vocab"):
+        Scheduler(engine, draft=ModelDraft(other, oparams))
+
+
+def test_oversized_prompt_rejected_mid_run(engine):
+    """A too-long prompt must come back rejected (error set) while the
+    rest of the batch completes normally."""
+    gen = np.random.default_rng(9)
+    good = [Request(gen.integers(0, 64, 5).tolist(), 4) for _ in range(2)]
+    bad = Request(list(range(BUCKETS[-1] + 1)), 4)
+    sched = Scheduler(engine, harvest_lag=1)
+    done = sched.run([good[0], bad, good[1]])
+    assert bad in done and bad.error is not None and not bad.tokens
+    assert "bucket" in bad.error
+    for r in good:
+        assert r.done and r.error is None and len(r.tokens) == 4
+    s = sched.metrics.summary()
+    assert s["requests_rejected"] == 1 and s["requests_finished"] == 2
