@@ -334,6 +334,80 @@ def bench_observability(steps: int = 192, batch_size: int = 64,
                 obs.step_time_s.p99 * 1e3, 3)}
 
 
+def bench_robustness(steps: int = 48, batch_size: int = 256,
+                     log_interval: int = 12) -> dict:
+    """Guard-overhead receipt: the SAME async ``train_epoch`` with the
+    resil step guard off vs folded into the compiled step (policy=skip,
+    host observe at every drain).
+
+    The guard's in-jit cost — one global grad norm + a scalar-predicated
+    state select — is DEVICE work that scales with parameter count but
+    not batch, so (unlike the host-overhead/observability rows, whose
+    additions are host-side constants) a sub-ms toy step would inflate
+    the ratio far beyond anything a real workload sees.  The row
+    therefore uses a wider MLP at a step time in the low milliseconds —
+    the small end of real training steps; on anything larger the
+    fraction only shrinks, since the guard cost is ~O(params) against
+    O(params x batch) compute.  The contract (ISSUE 5, same bar as the
+    observer): ``overhead_frac`` stays under 2%.  ``guard_bad_steps``
+    must be 0 — a fault-free run proves the guard never fires
+    spuriously.
+    """
+    from dtdl_tpu.data.loader import DataLoader
+    from dtdl_tpu.models import MLP
+    from dtdl_tpu.parallel.strategy import SingleDevice
+    from dtdl_tpu.resil import StepGuard
+    from dtdl_tpu.train import init_state, make_train_step, train_epoch
+
+    strategy = SingleDevice()
+    rng = np.random.default_rng(0)
+    n = steps * batch_size
+    dim = 256
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = rng.integers(0, 10, n).astype(np.int64)
+    loader = DataLoader({"image": x, "label": y}, batch_size, shuffle=False)
+    tx = optax.sgd(0.01)
+
+    def fresh_state():
+        return strategy.replicate(init_state(
+            MLP(n_units=512), jax.random.PRNGKey(0),
+            jnp.zeros((1, dim)), tx))
+
+    guard = StepGuard(policy="skip")
+    modes = {"off": (make_train_step(strategy), None),
+             "on": (make_train_step(strategy, guard=guard), guard)}
+    states = {k: fresh_state() for k in modes}
+    best = {k: 0.0 for k in modes}
+
+    def one_epoch(name):
+        step, g = modes[name]
+        t0 = time.perf_counter()
+        states[name], means = train_epoch(
+            step, states[name], loader, strategy,
+            log_interval=log_interval, guard=g)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(means["loss"])
+        return steps / dt
+
+    # warmup epoch each (compile), then interleaved repetitions with
+    # best-of-N per mode: a ~1% delta is far below this box's run-to-run
+    # drift (whole epochs swing 20%+ under ambient load), and load noise
+    # is additive-positive — the best epoch of many alternating reps
+    # approaches each mode's true floor instead of attributing ambient
+    # drift to whichever mode ran second
+    for name in modes:
+        one_epoch(name)
+    for _ in range(6):
+        for name in modes:
+            best[name] = max(best[name], one_epoch(name))
+    return {"model": "robustness", "batch_size": batch_size,
+            "steps": steps, "log_interval": log_interval,
+            "off_steps_per_sec": round(best["off"], 1),
+            "on_steps_per_sec": round(best["on"], 1),
+            "overhead_frac": round(1.0 - best["on"] / best["off"], 4),
+            **guard.summary()}
+
+
 def bench_serving(size: str = None, slot_sweep=(1, 4, 8),
                   new_tokens: int = 32) -> dict:
     """Serving throughput: prefill vs decode tokens/sec vs batch size.
@@ -760,6 +834,9 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-observability", action="store_true",
                    help="skip the observability-overhead (tracer on vs "
                         "off steps/sec) row")
+    p.add_argument("--skip-robustness", action="store_true",
+                   help="skip the robustness (resil step guard on vs off "
+                        "steps/sec) row")
     p.add_argument("--serve-size", default=None,
                    help="LM size for the serving row (default: tiny on "
                         "CPU, base on an accelerator)")
@@ -841,6 +918,21 @@ def main(argv=None) -> dict:
         records.append(obs_row)
         print("  " + json.dumps(obs_row), file=sys.stderr, flush=True)
 
+    resil_row = None
+    if not a.skip_robustness:
+        # robustness receipt: the resil step guard folded into the
+        # compiled step vs off through the same async train_epoch (<2%
+        # contract, ISSUE 5 — same bar as the observability row)
+        try:
+            resil_row = bench_robustness(
+                steps=max(24, a.sample_budget // 256) if a.sample_budget
+                else 48)
+        except Exception as e:   # the resil row must never sink the bench
+            resil_row = {"model": "robustness",
+                         "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(resil_row)
+        print("  " + json.dumps(resil_row), file=sys.stderr, flush=True)
+
     serve_row = None
     if not a.skip_serving:
         # serving row: prefill vs decode tokens/sec vs batch size — the
@@ -918,6 +1010,8 @@ def main(argv=None) -> dict:
             host_row["async_speedup_vs_sync"]
     if obs_row and "overhead_frac" in obs_row:
         summary["observability_overhead_frac"] = obs_row["overhead_frac"]
+    if resil_row and "overhead_frac" in resil_row:
+        summary["robustness_overhead_frac"] = resil_row["overhead_frac"]
     if serve_row and serve_row.get("sweep"):
         best_d = max(serve_row["sweep"],
                      key=lambda s: s["decode_tokens_per_sec"])

@@ -27,6 +27,10 @@ obs       observability: span tracer (Chrome trace / Perfetto export),
           recompile sentinel, goodput/MFU accounting, streaming
           latency-percentile histograms — one Observer facade that
           every loop flavor and the serve scheduler accept
+resil     fault tolerance: deterministic FaultPlan injection harness,
+          on-device step anomaly guard (skip/rollback/raise), SIGTERM
+          preemption watcher; checkpoint integrity + serve containment
+          live in ckpt/ and serve/
 launch    local, TPU-VM slice, and SLURM launchers (fail-fast +
           checkpoint-restart elasticity)
 utils     flags, seeding, timing, profiling, prototxt parsing

@@ -1,3 +1,3 @@
 from dtdl_tpu.ckpt.checkpoint import (  # noqa: F401
-    save_weights, load_weights, Checkpointer,
+    CheckpointCorruptError, save_weights, load_weights, Checkpointer,
 )

@@ -21,10 +21,28 @@ inconsistency where every DDP rank wrote a file (the rank-0 guard is
 commented out at reference pytorch/distributed_data_parallel.py:107) while
 ChainerMN gated on rank 0.  Under multi-host sharded states, orbax coordinates
 a distributed write instead (every host writes its shards).
+
+**Integrity (ISSUE 5)**: nothing here assumes a write finished.  Each
+msgpack blob carries a checksummed manifest sidecar
+(``<path>.manifest.json``: byte length + sha256) verified at load; a
+torn or truncated blob raises a named :class:`CheckpointCorruptError`
+(path + byte length) instead of an opaque flax deserialization error.
+Orbax snapshots gain a **commit marker** (a file written inside the
+snapshot dir only after ``wait_until_finished`` proves durability): a
+durable-looking dir without its marker is a write the process died
+inside, and restore-latest **quarantines** it (renamed ``*.corrupt``,
+kept for inspection, invisible to the snapshot regex) and falls back to
+the previous good snapshot.  The torn-write windows themselves are
+covered by the fault-injection sites ``ckpt.pre_rename`` /
+``ckpt.pre_commit`` (dtdl_tpu/resil/faults.py) and pinned by
+tests/test_resil.py.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 import re
 
@@ -32,11 +50,44 @@ import jax
 import numpy as np
 from flax import serialization
 
+from dtdl_tpu.resil.faults import fire as _fault
 from dtdl_tpu.runtime.bootstrap import barrier, is_leader
+
+log = logging.getLogger("dtdl_tpu")
+
+# commit marker written inside a snapshot dir once it is durable; a dir
+# without it is torn (the process died between orbax finalize and here)
+_COMMIT_MARKER = "_DTDL_COMMIT"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint artifact is torn, truncated, or fails its checksum.
+
+    Distinct from the architecture-mismatch ``ValueError`` (a *valid*
+    checkpoint for a different model): corruption is quarantined and
+    fallen back from; a mismatch is a caller error that must propagate.
+    """
+
+    def __init__(self, path: str, nbytes: int | None, reason: str):
+        self.path = path
+        self.nbytes = nbytes
+        size = "unknown size" if nbytes is None else f"{nbytes} bytes"
+        super().__init__(f"corrupt checkpoint {path} ({size}): {reason}")
+
+
+def _manifest_path(path: str) -> str:
+    return path + ".manifest.json"
 
 
 def save_weights(path: str, tree) -> str:
-    """Serialize a (replicated or host-local) pytree of weights to msgpack."""
+    """Serialize a (replicated or host-local) pytree of weights to msgpack.
+
+    Atomic per artifact: blob to ``.tmp`` then rename, then the manifest
+    (byte length + sha256) the same way.  A crash between the two
+    renames leaves a blob whose manifest describes the *previous* blob —
+    `load_weights` reads that as corrupt and the caller falls back,
+    which is the conservative end of the failure model (SCALING.md).
+    """
     tree = jax.device_get(tree)
     blob = serialization.to_bytes(tree)
     if is_leader():
@@ -44,13 +95,27 @@ def save_weights(path: str, tree) -> str:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
+        _fault("ckpt.pre_rename")   # the torn-write window, injectable
         os.replace(tmp, path)
+        manifest = {"bytes": len(blob),
+                    "sha256": hashlib.sha256(blob).hexdigest()}
+        mtmp = _manifest_path(path) + ".tmp"
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(mtmp, _manifest_path(path))
     barrier("save_weights")
     return path
 
 
 def load_weights(path: str, like):
     """Load weights saved by `save_weights` into the structure of ``like``.
+
+    **Integrity-checked**: when the manifest sidecar exists, the blob's
+    byte length and sha256 must match it; any mismatch — and any flax/
+    msgpack deserialization failure, which used to surface as an opaque
+    internal error — raises :class:`CheckpointCorruptError` naming the
+    path and byte length.  A manifest-less blob (external origin) skips
+    the checksum but still gets the named wrap on parse failure.
 
     **Shape-validated**: flax ``from_bytes`` happily returns the *stored*
     array when its shape differs from ``like``'s (verified: a (256,8,32)
@@ -59,10 +124,30 @@ def load_weights(path: str, like):
     different function or crash far from the cause.  Any leaf whose shape
     disagrees with ``like`` fails loudly here instead, naming the paths —
     e.g. snapshots predating a named-config geometry change (the round-3
-    head_dim-128 'small'/'base' presets) cannot silently load.
+    head_dim-128 'small'/'base' presets) cannot silently load.  This is
+    a ``ValueError``, NOT corruption — it must propagate, never be
+    quarantined.
     """
     with open(path, "rb") as f:
-        restored = serialization.from_bytes(like, f.read())
+        blob = f.read()
+    mpath = _manifest_path(path)
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if len(blob) != manifest.get("bytes"):
+            raise CheckpointCorruptError(
+                path, len(blob),
+                f"manifest says {manifest.get('bytes')} bytes — truncated "
+                f"or torn write")
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != manifest.get("sha256"):
+            raise CheckpointCorruptError(
+                path, len(blob), "sha256 mismatch against manifest")
+    try:
+        restored = serialization.from_bytes(like, blob)
+    except Exception as e:   # flax/msgpack errors are opaque — name them
+        raise CheckpointCorruptError(
+            path, len(blob), f"{type(e).__name__}: {e}") from e
     _validate_shapes(restored, like, path)
     return restored
 
@@ -133,9 +218,27 @@ class Checkpointer:
         # says nothing about the epoch-weights timeline and vice versa.
         self._restored_snapshot = False
         self._restored_weights = False
+        # steps saved async whose commit marker is not yet written; the
+        # marker lands at wait_until_finished, once orbax proves the dir
+        # durable — a dir without a marker is a torn write
+        self._pending_commit: set[int] = set()
         if is_leader():
             os.makedirs(directory, exist_ok=True)
         barrier("ckpt_mkdir")
+
+    # -- lifecycle: `with Checkpointer(...) as ck:` flushes-and-closes on
+    # ANY exit, exceptions included — an interrupted run must leave its
+    # last staged snapshot durable (and committed) rather than torn
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        try:
+            self.wait_until_finished()
+        finally:
+            self.close()
+        return False
 
     @property
     def _checkpointer(self):
@@ -162,9 +265,35 @@ class Checkpointer:
         """
         if self._ocp is not None:
             self._ocp.wait_until_finished()
+            self._commit_pending()
             if self._last_saved_step is not None:
                 self._gc(self._SNAP_RE, "snapshot_{}",
                          protect=self._last_saved_step)
+
+    def _commit_pending(self) -> None:
+        """Write the commit marker of every now-durable snapshot.
+
+        Runs right after orbax's ``wait_until_finished``: the snapshot
+        dirs have their final names, so marking them committed is the
+        last — and injectable (``ckpt.pre_commit``) — step of the save.
+        A crash before the marker leaves a durable-looking dir that
+        restore-latest quarantines and falls back from.  Every host
+        passes the trailing barrier before any of them can list/restore
+        — without it a non-leader racing ahead of the leader's marker
+        write would misread a just-committed snapshot as torn."""
+        if not self._pending_commit:
+            return
+        for step in sorted(self._pending_commit):
+            path = os.path.join(self.directory, f"snapshot_{step}")
+            if is_leader() and os.path.isdir(path):
+                _fault("ckpt.pre_commit")   # torn-finalize window
+                marker = os.path.join(path, _COMMIT_MARKER)
+                tmp = marker + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"step": step}, f)
+                os.replace(tmp, marker)
+        self._pending_commit.clear()
+        barrier("ckpt_commit")
 
     def close(self) -> None:
         if self._ocp is not None:
@@ -172,6 +301,22 @@ class Checkpointer:
             self._ocp = None
 
     # -- shape 2: per-epoch weights ------------------------------------------
+
+    def _quarantine(self, victim: str, err: Exception) -> None:
+        """Move a corrupt artifact out of the restore regexes' sight
+        (``<name>.corrupt``), keeping it on disk for inspection.  Leader-
+        gated like every mutation; the ``.corrupt`` suffix never matches
+        the ``$``-anchored snapshot/weights regexes, so the quarantined
+        entry can neither be restored nor occupy a ``keep`` slot."""
+        log.warning("quarantining corrupt checkpoint %s: %s", victim, err)
+        if not is_leader():
+            return
+        for src in (victim, victim + ".manifest.json"):
+            if os.path.exists(src):
+                try:
+                    os.replace(src, src + ".corrupt")
+                except OSError:   # never let cleanup mask the fallback
+                    pass
 
     def save_weights_epoch(self, epoch: int, params) -> str:
         path = os.path.join(self.directory,
@@ -189,16 +334,32 @@ class Checkpointer:
         return path
 
     def latest_weights(self, like):
-        """Restore-latest (``tf.train.latest_checkpoint`` parity)."""
-        epochs = self._list(self._WEIGHT_RE)
-        if not epochs:
-            return None, None
-        epoch = max(epochs)
-        path = os.path.join(self.directory,
-                            f"weights_epoch_{epoch:04d}.msgpack")
-        restored = load_weights(path, like)
-        self._restored_weights = True
-        return restored, epoch
+        """Restore-latest (``tf.train.latest_checkpoint`` parity).
+
+        **Corruption-tolerant**: a torn/truncated epoch file (named
+        :class:`CheckpointCorruptError` from `load_weights`) is
+        quarantined and the next-older epoch is tried — restore-latest
+        degrades by one epoch instead of crashing the resume.  An
+        architecture mismatch (``ValueError``) still propagates: every
+        epoch in the directory has the same geometry, so falling back
+        would just fail ``keep`` more times and then silently cold-start.
+        """
+        for epoch in sorted(self._list(self._WEIGHT_RE), reverse=True):
+            path = os.path.join(self.directory,
+                                f"weights_epoch_{epoch:04d}.msgpack")
+            try:
+                restored = load_weights(path, like)
+            except CheckpointCorruptError as e:
+                self._quarantine(path, e)
+                continue
+            except FileNotFoundError:
+                # multi-host race: the leader quarantined (renamed) this
+                # epoch between our listing and the open — fall back to
+                # the next one, exactly as if we had seen the rename
+                continue
+            self._restored_weights = True
+            return restored, epoch
+        return None, None
 
     # -- shape 3: full trainer-state snapshot --------------------------------
 
@@ -216,6 +377,7 @@ class Checkpointer:
             os.path.join(self.directory, f"snapshot_{step}"))
         self._checkpointer.save(path, state, force=True)
         self._last_saved_step = step
+        self._pending_commit.add(step)
         # Saving a step BELOW existing snapshot ids AFTER this run restored
         # an older snapshot means training rolled back, and the higher-step
         # snapshots belong to the abandoned timeline.  They must not
@@ -248,30 +410,93 @@ class Checkpointer:
 
         Returns (state, step) or (None, None) when no snapshot exists — the
         --resume flow (reference chainer/train_mnist.py:120-122).
+
+        **Preemption-safe**: restore-latest walks the snapshots newest
+        first, quarantining any torn one — missing commit marker (the
+        process died between orbax finalize and commit) or an orbax
+        restore failure — and falls back to the previous good snapshot,
+        so a crash mid-save costs at most one snapshot interval of work.
+        The marker is required only in a **marker-aware** directory (one
+        holding at least one committed snapshot): a directory written
+        entirely by a pre-marker version has no markers anywhere, and
+        condemning it wholesale would silently cold-start over good
+        data — legacy snapshots restore normally (orbax's own finalize
+        rename is atomic, so a durable-named legacy dir is complete).
+        An explicit ``step=`` raises :class:`CheckpointCorruptError`
+        instead (the caller asked for that exact snapshot); an
+        architecture mismatch (``ValueError``) always propagates.
         """
         self.wait_until_finished()
         steps = self._list(self._SNAP_RE)
         if not steps:
             return None, None
-        step = max(steps) if step is None else step
+        require_marker = any(self._committed(s) for s in steps)
+        if step is not None:
+            return self._restore_step(like, step, require_marker)
+        for s in sorted(steps, reverse=True):
+            try:
+                return self._restore_step(like, s, require_marker)
+            except CheckpointCorruptError as e:
+                self._quarantine(os.path.join(self.directory,
+                                              f"snapshot_{s}"), e)
+        return None, None
+
+    def _committed(self, step: int) -> bool:
+        return os.path.exists(os.path.join(
+            self.directory, f"snapshot_{step}", _COMMIT_MARKER))
+
+    def _restore_step(self, like, step: int, require_marker: bool = True):
+        """Restore one specific snapshot; CheckpointCorruptError when it
+        is torn (no commit marker, in a marker-aware directory) or orbax
+        cannot read it."""
         path = os.path.abspath(
             os.path.join(self.directory, f"snapshot_{step}"))
-        restored = self._checkpointer.restore(path, like)
+        if not os.path.isdir(path):
+            raise CheckpointCorruptError(path, None, "snapshot missing")
+        if require_marker and not self._committed(step):
+            raise CheckpointCorruptError(
+                path, None, "no commit marker — the writing process died "
+                "before the snapshot was finalized (torn write)")
+        try:
+            restored = self._checkpointer.restore(path, like)
+        except (ValueError, TypeError):
+            raise          # architecture/structure mismatch — caller error
+        except Exception as e:
+            raise CheckpointCorruptError(
+                path, None, f"{type(e).__name__}: {e}") from e
         _validate_shapes(restored, like, path)
         self._restored_snapshot = True
         return restored, step
 
     def latest_step(self) -> int | None:
-        """Step of the newest full-state snapshot (None when none exist)."""
+        """Step of the newest COMMITTED full-state snapshot (None when
+        none exist) — in a marker-aware directory, a durable-looking dir
+        without its commit marker is a torn write and never reported as
+        resumable (legacy marker-less directories report normally, as in
+        :meth:`restore`)."""
         self.wait_until_finished()
         steps = self._list(self._SNAP_RE)
+        committed = [s for s in steps if self._committed(s)]
+        if committed:
+            return max(committed)
         return max(steps) if steps else None
 
     def restore_path(self, like, path: str):
-        """Restore from an explicit snapshot path (--resume <path>)."""
+        """Restore from an explicit snapshot path (--resume <path>).
+
+        No commit-marker requirement — an explicit path is user intent
+        (and may point at an external/orbax-native snapshot) — but read
+        failures still come back as the named
+        :class:`CheckpointCorruptError` rather than orbax internals."""
         self.wait_until_finished()
         abspath = os.path.abspath(path.rstrip("/"))
-        restored = self._checkpointer.restore(abspath, like)
+        try:
+            restored = self._checkpointer.restore(abspath, like)
+        except (ValueError, TypeError):
+            raise          # structure mismatch — caller error
+        except Exception as e:
+            raise CheckpointCorruptError(
+                abspath, None, f"{type(e).__name__}: {e}") from e
         _validate_shapes(restored, like, path)
         # a rollback only rewrites THIS directory's timeline: restoring a
         # snapshot that lives elsewhere (warm start from another run) must

@@ -69,6 +69,12 @@ class Observer:
         """Host-phase span (context manager); no-op when tracing is off."""
         return self.tracer.span(name, **args)
 
+    def event(self, name: str, **args) -> None:
+        """Zero-duration marker on the trace (e.g. the resil guard's
+        ``guard_bad_step`` / ``guard_rollback``, the serve scheduler's
+        containment events).  Host-side only, like every verb here."""
+        self.tracer.instant(name, **args)
+
     def watch(self, fn: Callable, name: str | None = None,
               expected: int = 1) -> Callable:
         """Recompile-sentinel wrap (identity for non-jit callables)."""

@@ -9,7 +9,11 @@ context managers record complete ('X') events on the calling thread
 (the serve scheduler adds ``admit`` / ``harvest`` and, under
 speculative decoding, ``draft`` — host time inside the DraftSource —
 and ``verify`` — the k-wide verify dispatch, args carrying the step's
-draft width),
+draft width).  The resil layer marks its recoveries as zero-duration
+:meth:`Tracer.instant` events (``guard_bad_step`` / ``guard_rollback``
+/ ``trainer_preempted`` / ``request_expired`` / ``engine_failure`` /
+``scheduler_shutdown``, via ``Observer.event``), so a trace shows
+exactly where a run skipped, rolled back, or shed load.  Everything is
 thread-safe for the serve scheduler, exported as Chrome-trace-event JSON
 that Perfetto / ``chrome://tracing`` loads directly — the same format
 the XLA profiler emits, so the two traces read with the same tools

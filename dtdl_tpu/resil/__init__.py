@@ -1,0 +1,32 @@
+"""Fault tolerance: deterministic fault injection, anomaly-guarded
+training, preemption-safe checkpointing (ISSUE 5).
+
+Three pieces, wired through train/, ckpt/, and serve/:
+
+* :mod:`~dtdl_tpu.resil.faults` — the seeded :class:`FaultPlan` harness
+  that injects failures (loader exceptions, NaN bursts, torn checkpoint
+  writes, SIGTERM, slow-host stalls) at chosen occurrences, so every
+  recovery path below is exercised by tests/test_resil.py;
+* :mod:`~dtdl_tpu.resil.guard` — :class:`StepGuard`, the on-device
+  finite check folded into the compiled train step with skip /
+  rollback-to-last-good / raise policies, lag-harvested through the
+  PR-1 MetricsQueue (zero added per-step syncs);
+* :mod:`~dtdl_tpu.resil.preempt` — :class:`PreemptionWatcher`, the
+  SIGTERM → durable snapshot → exact mid-epoch resume path.
+
+Checkpoint integrity (checksummed msgpack manifests, orbax commit
+markers, corrupt-snapshot quarantine + fallback) lives in
+dtdl_tpu/ckpt/checkpoint.py; serve-side containment (deadlines,
+bounded admission, graceful drain, engine-failure blast-radius) in
+dtdl_tpu/serve/scheduler.py.  See README "Fault tolerance" and
+SCALING.md "Failure model".
+"""
+
+from dtdl_tpu.resil.faults import (  # noqa: F401
+    Fault, FaultPlan, InjectedCrash, InjectedFault, LoaderFaults, fire,
+    poison_batch,
+)
+from dtdl_tpu.resil.guard import (  # noqa: F401
+    AnomalousStepError, GuardEscalationError, GuardRollback, StepGuard,
+)
+from dtdl_tpu.resil.preempt import PreemptionWatcher  # noqa: F401

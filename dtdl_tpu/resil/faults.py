@@ -1,0 +1,241 @@
+"""Deterministic, seeded fault injection — recovery paths exercised by
+tests, not luck.
+
+The reference's checkpoint/resume idioms (SURVEY §5.4) assume nothing
+fails mid-write, mid-step, or mid-request.  The ROADMAP north star —
+production traffic from millions of users — guarantees the opposite:
+preemption, torn snapshot writes, NaN bursts, and hung hosts are
+routine.  This module is the harness that makes every one of those
+failures *reproducible*: a :class:`FaultPlan` names exactly which
+occurrence of which site fails, and how, so the recovery code in ckpt/,
+train/, and serve/ is pinned by tests/test_resil.py instead of hoped
+about.
+
+Two injection surfaces:
+
+* **product-code sites** — two narrow hooks compiled into the
+  checkpoint layer, each a single :func:`fire` call that is a no-op
+  dict lookup unless a plan is installed:
+
+  - ``ckpt.pre_rename``  — between the msgpack tmp-file write and its
+    ``os.replace`` (the classic torn-checkpoint window);
+  - ``ckpt.pre_commit``  — between an orbax snapshot becoming durable
+    and its commit marker being written (a preemption mid-finalize).
+
+  A ``crash`` fault at either site raises :class:`InjectedCrash`,
+  modeling the process dying at that instant: the test abandons the
+  instance and verifies a *fresh* run quarantines the torn artifact and
+  falls back to the previous good one.
+
+* **the data boundary** — :class:`LoaderFaults` wraps any loader and
+  injects at chosen global batch *yields* (site-local occurrence
+  counts), with no product hooks at all: ``raise`` (loader exception),
+  ``nan`` (poison every float array — the compiled step's grads go
+  non-finite, exercising the on-device guard for real), ``sigterm``
+  (deliver a real SIGTERM to this process — the preemption drill), and
+  ``stall`` (a slow-host sleep).
+
+Determinism: a fault fires at the Nth call of its site, full stop.
+Occurrence counters are **plan-local and monotonic**, so a replay after
+rollback/resume within the same plan does NOT re-fire (faults are
+transient, like a real NaN burst or preemption); a fresh process builds
+a fresh plan and chooses its own occurrence indices.  For randomized
+schedules, :meth:`FaultPlan.random` derives the fire steps from a seed
+— same seed, same schedule, bit for bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from collections import defaultdict
+from typing import Iterable, Optional
+
+import numpy as np
+
+KINDS = ("raise", "crash", "sigterm", "stall", "nan")
+
+
+class InjectedFault(RuntimeError):
+    """A fault deliberately injected by an installed FaultPlan."""
+
+
+class InjectedCrash(InjectedFault):
+    """Models the process dying at a chosen instant (e.g. between a
+    checkpoint tmp write and its rename).  Tests abandon the failing
+    instance when they catch this — nothing after the raise point ran,
+    exactly as if the host had been preempted there."""
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled failure: the ``at``-th call of ``site`` (0-based,
+    plan-local count) triggers ``kind``.  ``seconds`` is the stall
+    duration for ``kind='stall'``."""
+
+    site: str
+    at: int
+    kind: str = "raise"
+    seconds: float = 0.0
+    fired: bool = dataclasses.field(default=False, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {KINDS})")
+        if self.at < 0:
+            raise ValueError(f"fault occurrence index must be >= 0, "
+                             f"got {self.at}")
+
+
+class FaultPlan:
+    """A deterministic schedule of failures (see module docstring).
+
+    Build with the fluent :meth:`at` (or :meth:`random` for a seeded
+    schedule), then either ``with plan:`` to arm the product-code sites
+    for a block, or hand it to :class:`LoaderFaults` for data-boundary
+    faults (the wrapper consults the plan directly — no install
+    needed).  ``plan.log`` records every fault that actually fired, in
+    order, so tests assert the scenario ran as scripted.
+    """
+
+    def __init__(self, faults: Iterable[Fault] = ()):
+        self.faults: list[Fault] = list(faults)
+        self.log: list[tuple[str, int, str]] = []
+        self._counts: dict[str, int] = defaultdict(int)
+
+    # ---- schedule construction ---------------------------------------
+
+    def at(self, site: str, at: int, kind: str = "raise",
+           seconds: float = 0.0) -> "FaultPlan":
+        """Schedule ``kind`` at the ``at``-th occurrence of ``site``."""
+        self.faults.append(Fault(site, at, kind, seconds))
+        return self
+
+    @classmethod
+    def random(cls, seed: int, site: str, n_steps: int, rate: float,
+               kind: str = "nan") -> "FaultPlan":
+        """Seeded random schedule: each of ``n_steps`` occurrences of
+        ``site`` fails independently with probability ``rate``.  Same
+        seed, same schedule — the harness stays deterministic even when
+        the failure pattern is 'random'."""
+        rng = np.random.default_rng(seed)
+        plan = cls()
+        for i in np.nonzero(rng.random(n_steps) < rate)[0]:
+            plan.at(site, int(i), kind)
+        return plan
+
+    # ---- firing -------------------------------------------------------
+
+    def fire(self, site: str) -> Optional[Fault]:
+        """Record one occurrence of ``site``; trigger any fault scheduled
+        for it.  Control-flow kinds (raise/crash/sigterm/stall) trigger
+        here; data kinds (``nan``) are returned for the caller — e.g.
+        :class:`LoaderFaults` — to apply to its payload."""
+        i = self._counts[site]
+        self._counts[site] += 1
+        for f in self.faults:
+            if f.site == site and f.at == i and not f.fired:
+                f.fired = True
+                self.log.append((site, i, f.kind))
+                if f.kind in ("raise", "crash"):
+                    err = InjectedCrash if f.kind == "crash" else \
+                        InjectedFault
+                    raise err(f"injected {f.kind} at {site}#{i}")
+                if f.kind == "sigterm":
+                    os.kill(os.getpid(), signal.SIGTERM)
+                elif f.kind == "stall":
+                    time.sleep(f.seconds)
+                return f
+        return None
+
+    # ---- arming the product-code sites -------------------------------
+
+    def install(self) -> "FaultPlan":
+        global _PLAN
+        _PLAN = self
+        return self
+
+    def uninstall(self) -> None:
+        global _PLAN
+        if _PLAN is self:
+            _PLAN = None
+
+    def __enter__(self) -> "FaultPlan":
+        return self.install()
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def fire(site: str) -> None:
+    """The product-code hook: a no-op unless a plan is installed.
+
+    Sites live in checkpoint-critical windows (module docstring); the
+    uninstalled cost is one global read and an ``is None`` check, so the
+    hook stays in production builds — the harness tests the *same* code
+    that ships, not an instrumented twin."""
+    if _PLAN is not None:
+        _PLAN.fire(site)
+
+
+def poison_batch(batch: dict) -> dict:
+    """NaN-fill every float array of a batch (ints — labels, tokens —
+    pass through).  A NaN input makes the compiled step's loss and
+    gradients non-finite *on device*, which is exactly what the step
+    anomaly guard must catch — no host-side shortcut."""
+    return {k: (np.full_like(v, np.nan)
+                if np.issubdtype(np.asarray(v).dtype, np.floating) else v)
+            for k, v in batch.items()}
+
+
+class LoaderFaults:
+    """Loader wrapper injecting faults at chosen global batch yields.
+
+    Delegates the loader protocol (``set_epoch`` / ``__len__`` /
+    ``iter_from`` / ``batch_size``) so it drops into every loop flavor,
+    including mid-epoch resume.  The occurrence counter is the plan's
+    ``site`` count across the wrapper's whole life — epoch boundaries
+    and resume replays do NOT reset it, so an injected burst is
+    transient: a rollback that replays the same batch indices sees
+    clean data, the way a real NaN burst or preemption doesn't replay
+    itself.
+    """
+
+    def __init__(self, loader, plan: FaultPlan, site: str = "loader"):
+        self.loader = loader
+        self.plan = plan
+        self.site = site
+
+    # ---- loader protocol ---------------------------------------------
+
+    @property
+    def batch_size(self):
+        return self.loader.batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self):
+        return self._gen(iter(self.loader))
+
+    def iter_from(self, start_batch: int):
+        return self._gen(self.loader.iter_from(start_batch))
+
+    # ---- injection ----------------------------------------------------
+
+    def _gen(self, it):
+        for batch in it:
+            fault = self.plan.fire(self.site)  # may raise / kill / stall
+            if fault is not None and fault.kind == "nan":
+                batch = poison_batch(batch)
+            yield batch

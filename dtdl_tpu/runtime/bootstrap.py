@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import atexit
 import logging
+import os
 import socket
+import threading
 
 import jax
 
@@ -78,8 +80,55 @@ def is_leader() -> bool:
     return jax.process_index() == 0
 
 
-def barrier(name: str = "barrier") -> None:
-    """Cross-host sync point (no-op single-process)."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+class BarrierTimeoutError(RuntimeError):
+    """A cross-host barrier did not complete within its timeout — some
+    peer process is dead, hung, or partitioned.  The old behavior was to
+    hang forever inside ``sync_global_devices``, which turns one dead
+    host into a silent whole-job stall; this error names the barrier and
+    the budget so the launcher can kill/replace the job instead."""
+
+
+# default timeout for every barrier in the process (seconds); 0 / unset
+# keeps the legacy block-forever behavior, callers can still pass an
+# explicit timeout_s per call
+_DEFAULT_TIMEOUT = float(os.environ.get("DTDL_BARRIER_TIMEOUT_S", "0")) or None
+
+
+def barrier(name: str = "barrier", timeout_s: float | None = None) -> None:
+    """Cross-host sync point (no-op single-process).
+
+    ``timeout_s`` (or the process-wide ``DTDL_BARRIER_TIMEOUT_S`` env
+    default) bounds the wait: on expiry a named
+    :class:`BarrierTimeoutError` is raised instead of hanging forever on
+    a dead peer.  The timed-out sync keeps waiting on a daemon thread —
+    the collective cannot be cancelled — so treat the error as fatal for
+    this process (snapshot if possible, then exit); re-entering the same
+    barrier after a timeout is not supported.
+    """
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    timeout_s = timeout_s if timeout_s is not None else _DEFAULT_TIMEOUT
+    if timeout_s is None:
         multihost_utils.sync_global_devices(name)
+        return
+    done = threading.Event()
+    err: list[BaseException] = []
+
+    def _sync():
+        try:
+            multihost_utils.sync_global_devices(name)
+        except BaseException as e:  # surfaced to the caller below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_sync, daemon=True,
+                         name=f"dtdl-barrier-{name}")
+    t.start()
+    if not done.wait(timeout_s):
+        raise BarrierTimeoutError(
+            f"barrier {name!r} timed out after {timeout_s}s — a peer "
+            f"process is unreachable or dead")
+    if err:
+        raise err[0]

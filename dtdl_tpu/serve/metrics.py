@@ -46,6 +46,9 @@ class ServeMetrics:
         self.n_slots = n_slots
         self.n_submitted = 0
         self.n_rejected = 0
+        self.n_expired = 0      # deadline watchdog retirements
+        self.n_failed = 0       # engine-failure containment retirements
+        self.n_aborted = 0      # in-flight at a drain=False shutdown
         self.n_admitted = 0
         self.n_finished = 0
         self.n_decode_steps = 0
@@ -78,10 +81,30 @@ class ServeMetrics:
         self.n_submitted += 1
 
     def on_reject(self, req):
-        """Submit-time rejection (e.g. prompt past the largest bucket —
-        ``req.error`` carries the engine's diagnosis)."""
+        """Submit-time rejection (prompt past the largest bucket, full
+        admission queue, shut-down scheduler — ``req.error`` carries the
+        diagnosis)."""
         self.n_submitted += 1
         self.n_rejected += 1
+
+    def on_expire(self, req):
+        """Deadline-watchdog retirement (``req.deadline_s`` exceeded,
+        queued or mid-decode) — the containment path that keeps one hung
+        or over-budget request from occupying a slot forever."""
+        self.n_expired += 1
+
+    def on_failure(self, req):
+        """Engine-failure containment: the request was in flight when a
+        compiled program failed and retired with ``req.error`` set."""
+        self.n_failed += 1
+
+    def on_abort(self, req):
+        """Aborted by shutdown — queued-but-unadmitted, or in flight at
+        a non-draining shutdown.  A deliberate abort of an ALREADY
+        SUBMITTED request: counted separately so ``requests_failed``
+        stays an engine-health signal and ``requests_submitted`` (which
+        ``on_submit`` already incremented) is not double-counted."""
+        self.n_aborted += 1
 
     def on_draft(self, seconds: float):
         """One drafting phase's host time (dispatch-side; drafted/
@@ -157,6 +180,9 @@ class ServeMetrics:
         return {
             "requests_submitted": self.n_submitted,
             "requests_rejected": self.n_rejected,
+            "requests_expired": self.n_expired,
+            "requests_failed": self.n_failed,
+            "requests_aborted": self.n_aborted,
             "requests_finished": self.n_finished,
             "prefill_tokens": self.prefill_tokens,
             "decode_steps": self.n_decode_steps,

@@ -87,13 +87,17 @@ class Request:
     ``speculate`` is the request's maximum draft length (0 = plain
     decode); ``error`` is set instead of raising when the scheduler
     rejects the request at submit (e.g. prompt longer than the engine's
-    largest prefill bucket).
+    largest prefill bucket, admission queue full, scheduler shut down),
+    expires it past its ``deadline_s`` (wall-clock budget from submit;
+    None = no deadline), or fails it during engine containment — one
+    bad request never crashes a run with others in flight.
     """
     prompt: Sequence[int]
     max_new_tokens: int
     sampling: SampleParams = GREEDY
     eos_id: Optional[int] = None
     speculate: int = 0
+    deadline_s: Optional[float] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
@@ -196,10 +200,13 @@ class Scheduler:
 
     def __init__(self, engine: InferenceEngine, seed: int = 0,
                  harvest_lag: int = 4, metrics: ServeMetrics = None,
-                 observer=None, draft: Optional[DraftSource] = None):
+                 observer=None, draft: Optional[DraftSource] = None,
+                 max_queue: Optional[int] = None):
         if harvest_lag < 0:
             raise ValueError(f"harvest_lag must be >= 0, got "
                              f"{harvest_lag}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         # obs facade: thread-safe spans (admit/draft/dispatch/verify/
         # harvest) + the engine's recompile sentinel; defaults to no-ops
         self.observer = observer or NULL_OBSERVER
@@ -231,29 +238,63 @@ class Scheduler:
         # None (=1 each), ((slot, rid, draft_len), ...))
         self._pending: deque[tuple[Any, Any, tuple]] = deque()
         self.step_count = 0
+        # containment state: bounded admission + graceful shutdown +
+        # the blast radius of an engine failure (see step()/shutdown())
+        self.max_queue = max_queue
+        self._closed = False
+        self.last_engine_error: Optional[str] = None
+        # watchdog early-out: stays False until a deadline-carrying
+        # request is submitted, so the per-step queue/slot scan is free
+        # for the (default) deadline-less workload
+        self._deadlines_seen = False
 
     # ---- intake -------------------------------------------------------
 
+    def _finish_error(self, req: Request, reason: str,
+                      metric_hook) -> Request:
+        """The one terminal-error path: ``req.error`` set, request
+        finished, the given metrics hook (on_reject / on_expire /
+        on_failure / on_abort) counts it — every containment branch
+        funnels through here so retirement bookkeeping cannot drift."""
+        req.error = reason
+        req.done = True
+        req.t_done = time.perf_counter()
+        self.finished.append(req)
+        metric_hook(req)
+        return req
+
+    def _reject(self, req: Request, reason: str) -> Request:
+        """Terminal submit-time rejection: ``req.error`` set, counted,
+        run unharmed — the named-error-instead-of-crash path shared by
+        oversized prompts, a full admission queue, and shutdown."""
+        self._reqs[req.rid] = req
+        return self._finish_error(req, reason, self.metrics.on_reject)
+
     def submit(self, req: Request) -> Request:
-        """Enqueue ``req``; a prompt the engine cannot prefill comes back
-        as a *rejected* request (``req.error`` set, ``req.done`` True,
-        counted in ``requests_rejected``) instead of raising — one
-        oversized prompt must not crash a run with other requests in
-        flight."""
+        """Enqueue ``req``; a request the scheduler cannot serve comes
+        back *rejected* (``req.error`` set, ``req.done`` True, counted in
+        ``requests_rejected``) instead of raising — one bad request must
+        not crash a run with other requests in flight.  Rejection
+        reasons: prompt past the largest prefill bucket, admission queue
+        at ``max_queue`` (bounded intake: a traffic spike sheds load
+        here, with a named reason, instead of growing an unbounded host
+        queue), or a shut-down scheduler."""
         prompt_len = len(req.prompt)
         if prompt_len < 1:
             raise ValueError("empty prompt")
         req.t_submit = time.perf_counter()
+        if self._closed:
+            return self._reject(req, "scheduler is shut down")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            return self._reject(
+                req, f"admission queue full ({self.max_queue} waiting); "
+                     f"retry later")
         try:
             self.engine.bucket_for(prompt_len)
         except PromptTooLongError as e:
-            req.error = str(e)
-            req.done = True
-            req.t_done = req.t_submit
-            self._reqs[req.rid] = req
-            self.finished.append(req)
-            self.metrics.on_reject(req)
-            return req
+            return self._reject(req, str(e))
+        if req.deadline_s is not None:
+            self._deadlines_seen = True
         self._reqs[req.rid] = req
         self.queue.append(req)
         self.metrics.on_submit(req)
@@ -277,15 +318,99 @@ class Scheduler:
         self.slots[slot] = None
         self._active[slot] = False
 
+    def _expire(self):
+        """Deadline watchdog: retire any request past its wall-clock
+        budget with ``req.error`` set — queued or in a slot.  Freeing a
+        slot never touches the KV arena (the row is inactive until the
+        next prefill overwrites it, the same discipline as retirement),
+        and any in-flight harvest windows for the request are dropped by
+        the existing ``req.done`` skip, so an expired request cannot
+        poison later occupants of its row.  The scan costs nothing until
+        the first deadline-carrying request is submitted."""
+        if not self._deadlines_seen:
+            return
+        now = time.perf_counter()
+
+        def expired(req):
+            return (req.deadline_s is not None
+                    and now - req.t_submit >= req.deadline_s)
+
+        for req in [r for r in self.queue if expired(r)]:
+            self.queue.remove(req)
+            self._finish_error(
+                req, f"deadline {req.deadline_s}s exceeded before "
+                     f"admission", self.metrics.on_expire)
+            self.observer.event("request_expired", rid=req.rid, queued=1)
+        for slot, req in enumerate(self.slots):
+            if req is None or not self._active[slot] or not expired(req):
+                continue
+            self._finish_error(
+                req, f"deadline {req.deadline_s}s exceeded after "
+                     f"{len(req.tokens)} tokens", self.metrics.on_expire)
+            self.observer.event("request_expired", rid=req.rid, slot=slot)
+            self._retire(slot)
+
+    def _contain(self, exc: BaseException):
+        """Engine-failure blast radius: the in-flight batch.
+
+        A compiled program failing mid-dispatch leaves the donated arena
+        in an unknown state, so everything referencing it is condemned:
+        every slotted request retires with ``req.error`` set and the
+        arena/last-token state is re-initialized.  Harvest windows
+        dispatched BEFORE the failure are intact output buffers from
+        completed programs — they are delivered first (best-effort), so
+        a request that already retired on guaranteed budget and was only
+        waiting on the lag harvest still finishes cleanly rather than
+        being orphaned ``done=False``; any such request the harvest
+        could not settle is error-finished like the slotted ones.  The
+        admission queue survives — the next step admits and serves it
+        against the fresh arena."""
+        self.last_engine_error = f"{type(exc).__name__}: {exc}"
+        self.observer.event("engine_failure", error=self.last_engine_error)
+        pending_rids = {rid for _, _, entries in self._pending
+                        for _, rid, _ in entries}
+        try:
+            while self._pending:
+                self._harvest_one()
+        except Exception:          # device state unusable — drop the rest
+            self._pending.clear()
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self._finish_error(
+                req, f"engine failure: {self.last_engine_error}",
+                self.metrics.on_failure)
+            self._retire(slot)
+            self._state[slot] = None
+        for rid in pending_rids:   # retired-for-budget but unharvested
+            req = self._reqs[rid]
+            if not req.done:
+                self._finish_error(
+                    req, f"engine failure: {self.last_engine_error}",
+                    self.metrics.on_failure)
+        self.arena = self.engine.init_arena()
+        self.last_tokens = self.engine.init_last_tokens()
+
     def _admit(self):
+        if self._closed:
+            return
         for slot in range(self.engine.n_slots):
             if self.slots[slot] is not None or not self.queue:
                 continue
             req = self.queue.popleft()
             sp = req.sampling
-            self.arena, self.last_tokens, _ = self.engine.prefill(
-                self.arena, self.last_tokens, slot, req.prompt, sp,
-                self._next_key())
+            try:
+                self.arena, self.last_tokens, _ = self.engine.prefill(
+                    self.arena, self.last_tokens, slot, req.prompt, sp,
+                    self._next_key())
+            except Exception as e:
+                # the arena was donated into the failing program: condemn
+                # the in-flight batch (and this request), keep the queue
+                self._contain(e)
+                self._finish_error(
+                    req, f"engine failure: {self.last_engine_error}",
+                    self.metrics.on_failure)
+                return
             self.slots[slot] = req
             self._active[slot] = True
             self._state[slot] = _SlotState(req.rid, len(req.prompt),
@@ -370,8 +495,12 @@ class Scheduler:
     # ---- the decode round --------------------------------------------
 
     def step(self) -> int:
-        """One admit + draft + decode/verify round; returns how many
-        slots stepped."""
+        """One watchdog + admit + draft + decode/verify round; returns
+        how many slots stepped.  Engine failures are contained to the
+        in-flight batch (see :meth:`_contain`); deadline-expired
+        requests retire with ``req.error`` before any work is spent on
+        them this round."""
+        self._expire()
         with self.observer.span("admit"):
             self._admit()
         # overflow settling: a speculative slot's worst-case index may
@@ -384,44 +513,11 @@ class Scheduler:
                 self._harvest_one()
         n_active = int(self._active.sum())
         if n_active:
-            t_draft = time.perf_counter()
-            with self.observer.span("draft", n_active=n_active):
-                k_prog, drafts, lens = self._make_drafts()
-            self.metrics.on_draft(time.perf_counter() - t_draft)
-            if k_prog > 0:
-                entries = tuple(
-                    (slot, req.rid, int(lens[slot]))
-                    for slot, req in enumerate(self.slots)
-                    if self._active[slot])
-                with self.observer.span("verify", n_active=n_active,
-                                        k=k_prog):
-                    (self.arena, self.last_tokens, window,
-                     counts) = self.engine.verify(
-                        self.arena, self.last_tokens, drafts, lens,
-                        self._active, self._next_key(), self._temp,
-                        self._topk, self._topp)
-                self._pending.append((window, counts, entries))
-                self.metrics.on_verify(k_prog)
-                for slot, rid, dl in entries:
-                    self._state[slot].dispatched(dl)
-            else:
-                entries = tuple(
-                    (slot, req.rid, 0)
-                    for slot, req in enumerate(self.slots)
-                    if self._active[slot])
-                with self.observer.span("dispatch", n_active=n_active):
-                    self.arena, self.last_tokens, _ = self.engine.decode(
-                        self.arena, self.last_tokens, self._active,
-                        self._next_key(), self._temp, self._topk,
-                        self._topp)
-                self._pending.append((self.last_tokens, None, entries))
-                for slot, rid, _ in entries:
-                    self._state[slot].dispatched(0)
-            for slot, rid, _ in entries:
-                req = self.slots[slot]
-                req._guaranteed += 1
-                if req._guaranteed >= self._budget(req):
-                    self._retire(slot)
+            try:
+                self._dispatch_round(n_active)
+            except Exception as e:
+                # containment: fail the in-flight batch, keep serving
+                self._contain(e)
         self.step_count += 1
         self.metrics.on_step(n_active, self.engine.n_slots)
         if len(self._pending) > self.harvest_lag:
@@ -429,6 +525,48 @@ class Scheduler:
                 while len(self._pending) > self.harvest_lag:
                     self._harvest_one()
         return n_active
+
+    def _dispatch_round(self, n_active: int):
+        """The draft + decode/verify dispatch of one round (factored out
+        so step() can contain an engine failure to this batch)."""
+        t_draft = time.perf_counter()
+        with self.observer.span("draft", n_active=n_active):
+            k_prog, drafts, lens = self._make_drafts()
+        self.metrics.on_draft(time.perf_counter() - t_draft)
+        if k_prog > 0:
+            entries = tuple(
+                (slot, req.rid, int(lens[slot]))
+                for slot, req in enumerate(self.slots)
+                if self._active[slot])
+            with self.observer.span("verify", n_active=n_active,
+                                    k=k_prog):
+                (self.arena, self.last_tokens, window,
+                 counts) = self.engine.verify(
+                    self.arena, self.last_tokens, drafts, lens,
+                    self._active, self._next_key(), self._temp,
+                    self._topk, self._topp)
+            self._pending.append((window, counts, entries))
+            self.metrics.on_verify(k_prog)
+            for slot, rid, dl in entries:
+                self._state[slot].dispatched(dl)
+        else:
+            entries = tuple(
+                (slot, req.rid, 0)
+                for slot, req in enumerate(self.slots)
+                if self._active[slot])
+            with self.observer.span("dispatch", n_active=n_active):
+                self.arena, self.last_tokens, _ = self.engine.decode(
+                    self.arena, self.last_tokens, self._active,
+                    self._next_key(), self._temp, self._topk,
+                    self._topp)
+            self._pending.append((self.last_tokens, None, entries))
+            for slot, rid, _ in entries:
+                self._state[slot].dispatched(0)
+        for slot, rid, _ in entries:
+            req = self.slots[slot]
+            req._guaranteed += 1
+            if req._guaranteed >= self._budget(req):
+                self._retire(slot)
 
     # ---- harvest ------------------------------------------------------
 
@@ -477,6 +615,59 @@ class Scheduler:
         with self.observer.span("drain"):
             while self._pending:
                 self._harvest_one()
+
+    # ---- shutdown -----------------------------------------------------
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop the intake and wind the scheduler down.
+
+        ``drain=True`` (graceful): queued-but-unadmitted requests are
+        aborted with a named error (they never started; re-submittable
+        elsewhere), in-flight requests run to completion, and every
+        pending harvest settles — no generated token is lost.
+        ``drain=False`` (abort): no further steps are dispatched;
+        already-computed harvest windows are still settled (pure host
+        reads — a request that only awaited the lag harvest finishes
+        cleanly instead of being orphaned), then the remaining in-flight
+        requests retire with ``req.error`` set.  Idempotent; ``submit``
+        after shutdown rejects.
+        """
+        already = self._closed
+        self._closed = True
+        while self.queue:
+            # on_abort, not on_reject: these were counted by on_submit
+            # already — on_reject's n_submitted increment would double-
+            # count them and break the submitted == finished+rejected+
+            # expired+failed+aborted invariant
+            self._finish_error(self.queue.popleft(),
+                               "scheduler shut down before admission",
+                               self.metrics.on_abort)
+        if already:
+            return
+        self.observer.event("scheduler_shutdown", drain=int(drain))
+        if drain:
+            while any(s is not None for s in self.slots):
+                self.step()
+            self.drain()
+            return
+        self.drain()     # settle what the device already computed
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            # a deliberate abort, not an engine failure: counted under
+            # requests_aborted so the failure alert stays meaningful
+            self._finish_error(req, "scheduler shut down",
+                               self.metrics.on_abort)
+            self._retire(slot)
+
+    def __enter__(self) -> "Scheduler":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        # clean exit drains gracefully; an exception aborts (stepping a
+        # possibly-broken engine to drain would compound the failure)
+        self.shutdown(drain=exc_type is None)
+        return False
 
     # ---- driver -------------------------------------------------------
 

@@ -104,7 +104,8 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
                 log_interval: int = 20, timer: StepTimer | None = None,
                 prefetch: int = 2, profile_dir: str | None = None,
                 sync_every_step: bool = False, lag: int | None = None,
-                unroll: int = 1, observer: Observer | None = None):
+                unroll: int = 1, observer: Observer | None = None,
+                guard=None):
     """Run one epoch; returns (state, epoch_mean_metrics).
 
     Async by default: metrics are drained (one host↔device sync) once per
@@ -122,6 +123,15 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
     fields merged into the boundary reports — all host-side, so the
     one-sync-per-window contract is unchanged (pinned by
     tests/test_obs.py's sync-counting test).
+
+    ``guard`` (a :class:`dtdl_tpu.resil.StepGuard`) must be the SAME
+    instance folded into ``train_step`` via ``make_train_step(...,
+    guard=)``: the step suppresses bad updates on device; this loop
+    feeds every drained per-step dict to ``guard.observe`` so the host
+    policy (skip-count / raise / escalate) runs at the boundaries it
+    already syncs at — the guard adds no syncs of its own.  The
+    ``rollback`` policy needs a checkpointer and is therefore a Trainer
+    feature; from this loop its GuardRollback propagates to the caller.
     """
     from dtdl_tpu.utils.profiling import maybe_trace, step_annotation
     if unroll < 1:
@@ -149,7 +159,10 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
                 timer.step(metrics["loss"])
                 # blocking mode: every step is its own settled window
                 goodput = obs.window(1, timer.last_step_s)
-                acc.add({k: float(v) for k, v in metrics.items()})
+                vals = {k: float(v) for k, v in metrics.items()}
+                if guard is not None:
+                    guard.observe(vals)
+                acc.add(vals)
                 if reporter is not None and (i % log_interval) == 0:
                     reporter.report({
                         "epoch": epoch, "step": i,
@@ -196,6 +209,8 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
                 timer.step()
             popped = queue.push(metrics, count=n)
             for vals in popped:
+                if guard is not None:
+                    guard.observe(vals)
                 acc.add(vals)
             if popped:
                 latest = popped[-1]
@@ -205,6 +220,8 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
                 with obs.span("drain", steps=step0 + n - window_start):
                     drained = queue.drain()
                 for vals in drained:
+                    if guard is not None:
+                        guard.observe(vals)
                     acc.add(vals)
                 if drained:
                     latest = drained[-1]
@@ -224,6 +241,8 @@ def train_epoch(train_step, state, loader, strategy: Strategy,
             step0 += n
     with obs.span("drain", steps=step0 - window_start):
         for vals in queue.drain():
+            if guard is not None:
+                guard.observe(vals)
             acc.add(vals)
     timer.sync()
     if step0 > window_start:

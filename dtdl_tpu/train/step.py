@@ -53,7 +53,7 @@ def _dropout_rngs(state: TrainState, strategy: Strategy, seed: int):
 
 def make_train_step(strategy: Strategy | None = None,
                     loss_fn: Callable = softmax_cross_entropy,
-                    seed: int = 0):
+                    seed: int = 0, guard=None):
     """Build the compiled step ``(state, batch) -> (state, metrics)``.
 
     ``batch`` is a dict with ``image`` (global batch, leading dim sharded on
@@ -61,6 +61,14 @@ def make_train_step(strategy: Strategy | None = None,
     as globally averaged scalars (loss, accuracy) — what the reference prints
     every 20 steps (pytorch/distributed_data_parallel.py:144-148).
     ``seed`` feeds the per-step dropout rng (for models that use dropout).
+
+    ``guard`` (a :class:`dtdl_tpu.resil.StepGuard`) folds the on-device
+    anomaly check into this same program: a non-finite loss/grad-norm
+    step keeps the old state (``where`` select — bitwise identical to
+    unguarded when no fault fires) and the ``bad_step``/``grad_norm``
+    metrics ride the async queue, zero added syncs.  The select runs on
+    the metric-synced loss and post-``grad_sync`` grads so every replica
+    takes the same branch.
     """
     strategy = strategy or SingleDevice()
 
@@ -85,6 +93,10 @@ def make_train_step(strategy: Strategy | None = None,
             "loss": loss,
             "accuracy": accuracy(logits, batch["label"]),
         })
+        if guard is not None:
+            new_state, gm = guard.select(state, new_state,
+                                         metrics["loss"], grads)
+            metrics.update(gm)
         return new_state, metrics
 
     return strategy.compile(step)
@@ -124,7 +136,7 @@ def make_eval_step(strategy: Strategy | None = None,
 
 def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
                        vocab_chunk_size: int = 0,
-                       moe_aux_weight: float = 0.01):
+                       moe_aux_weight: float = 0.01, guard=None):
     """Compiled causal-LM step ``(state, batch) -> (state, metrics)``.
 
     ``batch``: {'tokens': int32 [B, S]} (optionally 'mask' f32 [B, S-1] over
@@ -145,6 +157,9 @@ def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
     megatron path does the same — parallel/megatron.py).  Without this the
     sow is silently dropped and capacity routing collapses onto few
     experts.  Reported as the ``moe_aux_loss`` metric; 0 disables.
+
+    ``guard`` folds the resil anomaly check into the program, exactly as
+    in :func:`make_train_step`.
     """
     strategy = strategy or SingleDevice()
 
@@ -221,6 +236,10 @@ def make_lm_train_step(strategy: Strategy | None = None, seed: int = 0,
         if aux is not None:
             metrics["moe_aux_loss"] = aux
         metrics = strategy.metric_sync(metrics)
+        if guard is not None:
+            new_state, gm = guard.select(state, new_state,
+                                         metrics["loss"], grads)
+            metrics.update(gm)
         return new_state, metrics
 
     return strategy.compile(step)

@@ -31,6 +31,7 @@ from dtdl_tpu.metrics.device import MetricsQueue
 from dtdl_tpu.metrics.report import Accumulator, JsonlSink, Reporter, StdoutSink
 from dtdl_tpu.obs.observer import NULL_OBSERVER
 from dtdl_tpu.parallel.strategy import Strategy
+from dtdl_tpu.resil.guard import GuardEscalationError, GuardRollback
 from dtdl_tpu.runtime.bootstrap import is_leader
 from dtdl_tpu.utils.timing import StepTimer
 
@@ -79,12 +80,23 @@ class Trainer:
 
     def __init__(self, state, train_step, train_loader, strategy: Strategy,
                  stop_trigger=(20, "epoch"), out: str = "./result",
-                 prefetch: int = 2, metrics_lag: int = 20, observer=None):
+                 prefetch: int = 2, metrics_lag: int = 20, observer=None,
+                 guard=None, preempt=None):
         self.state = state
         self.train_step = train_step
         # obs facade (dtdl_tpu.obs): spans + recompile sentinel + goodput;
         # the default NULL_OBSERVER no-ops every hook
         self.observer = observer or NULL_OBSERVER
+        # resil wiring: ``guard`` must be the instance folded into
+        # train_step (make_train_step(..., guard=)) — the Trainer feeds it
+        # every drained step and handles its rollback policy by restoring
+        # the last good snapshot; ``preempt`` is a PreemptionWatcher whose
+        # flag is polled at iteration boundaries — on SIGTERM the run
+        # snapshots and returns with ``self.preempted`` set, and a fresh
+        # Trainer's resume() continues exactly (mid-epoch included)
+        self.guard = guard
+        self.preempt = preempt
+        self.preempted = False
         self.train_loader = train_loader
         self.strategy = strategy
         self.stop = Trigger.of(stop_trigger)
@@ -136,6 +148,8 @@ class Trainer:
         with self.observer.span("drain"):
             drained = self.metrics_queue.drain()
         for vals in drained:
+            if self.guard is not None:
+                self.guard.observe(vals)
             self.observation = vals
             self.accumulator.add(vals)
         if drained:
@@ -163,42 +177,81 @@ class Trainer:
     def _run(self) -> None:
         step_fn = self.observer.watch(self.train_step, "trainer.train_step")
         while not self._done:
-            self.train_loader.set_epoch(self.epoch)
-            self.timer.reset_epoch()
-            if self._skip_batches:
-                # mid-epoch resume: the sampler's (seed, epoch) order and
-                # the per-batch-keyed transform rng are deterministic, so
-                # starting at the consumed prefix replays the exact
-                # remainder of the interrupted epoch (Chainer resume parity
-                # — its snapshot serializes the iterator position, reference
-                # chainer/train_mnist.py:120-122).  O(1) via iter_from.
-                skip = self._skip_batches
-                self._skip_batches = 0
-                raw = resume_iter(self.train_loader, skip)
-            else:
-                raw = iter(self.train_loader)
-                self.iteration_in_epoch = 0
-            it = prefetch_to_device(raw, self.strategy.shard_batch,
-                                    self.prefetch)
-            for batch in it:
-                with self.observer.span("dispatch", iteration=self.iteration):
-                    self.state, metrics = step_fn(self.state, batch)
-                self.iteration += 1
-                self.iteration_in_epoch += 1
-                self.timer.step()
-                for vals in self.metrics_queue.push(metrics):
-                    self.observation = vals
-                    self.accumulator.add(vals)
-                done = self._done and self.stop.unit == "iteration"
-                if done or self._will_fire("iteration"):
-                    self._drain_metrics()
-                self._fire("iteration")
-                if done:
+            try:
+                if self._run_epoch(step_fn):
                     return
-            self.epoch += 1
+            except GuardRollback:
+                # the guard's rollback policy escalated: restore the last
+                # good snapshot and continue from there (mid-epoch exact,
+                # via the same resume path as a restart)
+                self._rollback()
+
+    def _rollback(self) -> None:
+        # in-flight metrics belong to the abandoned timeline — settle and
+        # discard them (the queued device work is harmless: the guard's
+        # in-jit select already kept any bad update out of the state)
+        self.metrics_queue.drain()
+        self.accumulator.reset()
+        self.observer.event("trainer_rollback", iteration=self.iteration)
+        if not self.resume():
+            raise GuardEscalationError(
+                f"guard requested rollback-to-last-good but no snapshot "
+                f"exists in {self.out} — add the snapshot extension (or "
+                f"use policy='skip')")
+
+    def _check_preempt(self) -> bool:
+        """SIGTERM received: snapshot at this (consistent) boundary and
+        stop; run()'s finally makes it durable + committed.  Resume in a
+        fresh process continues exactly."""
+        if self.preempt is None or not self.preempt.requested:
+            return False
+        self.observer.event("trainer_preempted", iteration=self.iteration)
+        self.save_snapshot()
+        self.preempted = True
+        return True
+
+    def _run_epoch(self, step_fn) -> bool:
+        """One epoch (or the remainder of one after resume/rollback);
+        True when the run should stop (done or preempted)."""
+        self.train_loader.set_epoch(self.epoch)
+        self.timer.reset_epoch()
+        if self._skip_batches:
+            # mid-epoch resume: the sampler's (seed, epoch) order and
+            # the per-batch-keyed transform rng are deterministic, so
+            # starting at the consumed prefix replays the exact
+            # remainder of the interrupted epoch (Chainer resume parity
+            # — its snapshot serializes the iterator position, reference
+            # chainer/train_mnist.py:120-122).  O(1) via iter_from.
+            skip = self._skip_batches
+            self._skip_batches = 0
+            raw = resume_iter(self.train_loader, skip)
+        else:
+            raw = iter(self.train_loader)
             self.iteration_in_epoch = 0
-            self._drain_metrics()
-            self._fire("epoch")
+        it = prefetch_to_device(raw, self.strategy.shard_batch,
+                                self.prefetch)
+        for batch in it:
+            with self.observer.span("dispatch", iteration=self.iteration):
+                self.state, metrics = step_fn(self.state, batch)
+            self.iteration += 1
+            self.iteration_in_epoch += 1
+            self.timer.step()
+            for vals in self.metrics_queue.push(metrics):
+                if self.guard is not None:
+                    self.guard.observe(vals)
+                self.observation = vals
+                self.accumulator.add(vals)
+            done = self._done and self.stop.unit == "iteration"
+            if done or self._will_fire("iteration"):
+                self._drain_metrics()
+            self._fire("iteration")
+            if done or self._check_preempt():
+                return True
+        self.epoch += 1
+        self.iteration_in_epoch = 0
+        self._drain_metrics()
+        self._fire("epoch")
+        return self._check_preempt()
 
     # -- snapshot / resume ----------------------------------------------------
 
