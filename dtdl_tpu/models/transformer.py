@@ -68,6 +68,20 @@ def _part(init, *names):
     return nn.with_logical_partitioning(init, names)
 
 
+def _required_cache_leaf(name):
+    """Init fn for cache leaves the caller must supply (the paged-arena
+    layout is built by the serving engine, never by an init trace): if
+    flax falls back to initializing one, the cache pytree was malformed
+    — fail with the diagnosis instead of allocating a silent zero."""
+    def init(*_):
+        raise ValueError(
+            f"paged KV cache is missing the '{name}' leaf; build the "
+            f"arena with TransformerLM.init_paged_cache and let the "
+            f"serving engine insert the per-call page_table/active "
+            f"leaves (dtdl_tpu/serve/engine.py)")
+    return init
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
     dtype: Dtype = jnp.float32
@@ -157,6 +171,11 @@ class Attention(nn.Module):
             raise CacheOverflowError(
                 f"{s_new} new tokens cannot fit a max_seq={max_len} "
                 f"KV cache/rope table")
+        # block-paged arena (cache built by init_paged_cache, page
+        # tables inserted per call by the serving engine): route before
+        # the dense declarations below can allocate [B, max_seq] buffers
+        if self.has_variable("cache", "pages_key"):
+            return self._paged_attend_slots(q, k, v, cos, sin)
         # has_variable BEFORE self.variable: during the init trace the
         # cache does not exist yet, and mutating it there would bake the
         # example input into the returned cache and leave index=1 — every
@@ -276,6 +295,117 @@ class Attention(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd",
                           probs.astype(self.dtype), cv.value)
+
+    def _paged_attend_slots(self, q, k, v, cos, sin):
+        """The vector-index cached attend (:meth:`_verify_attend_slots`)
+        generalized to a **block-paged** KV arena: instead of row b
+        owning a contiguous ``[max_seq]`` cache row, its positions map
+        through a per-row page table onto a shared pool of
+        ``page_size``-token pages (``pages_key``/``pages_value``
+        ``[n_pages, H, page_size, D]``), so a short sequence pins only
+        the pages it has reached.  Per row the math is IDENTICAL to the
+        dense vector path — rope at each token's true global position,
+        K/V scattered at ``pos[b] .. pos[b]+s_new-1`` (now through the
+        table), causal mask per query row over the gathered logical view
+        — which is what keeps paged decode/verify token-identical to the
+        dense arena (tests/test_paged_kv.py).  ``s_new`` spans the same
+        three shapes: prefill (B=1, S=suffix bucket, index=#cached
+        prefix tokens), decode (S=1), speculative verify (S=k+1).
+
+        Cache leaves: ``pages_key``/``pages_value`` (the pool),
+        ``index`` [B] — the arena the engine donates — plus two
+        **per-call data leaves** the engine inserts before ``apply`` and
+        strips after: ``page_table`` [B, n_ptab] int32 (logical page ->
+        physical page; unmapped entries point at the reserved garbage
+        page 0) and ``active`` [B] bool.  Page tables are data, never
+        shapes: remapping pages or changing occupancy reuses the same
+        compiled program.
+
+        The one discipline the dense path did not need: an INACTIVE
+        row's write is explicitly routed to the garbage page.  Dense
+        slots write garbage into their *own* row (harmless); a paged
+        slot's stale table may point at pages long since freed and
+        remapped to a live request, so writes gate on ``active``.
+        Positions of garbage rows are also clamped before they index
+        the rope/page tables — out-of-range stale indices must produce
+        discarded garbage, not NaNs that a masked-but-gathered page
+        could leak into a live row's softmax·V sum (0 · NaN = NaN).
+
+        Callers guarantee, for every ACTIVE row, ``pos[b] + s_new <=
+        max_seq`` and a table mapping every logical page up to that
+        bound (the serving scheduler allocates pages from the same
+        worst-case index tracking it already settles overflow with).
+        """
+        import math
+        b, h, s_new, d = q.shape
+        max_len = cos.shape[0]
+        pk = self.variable("cache", "pages_key",
+                           _required_cache_leaf("pages_key"))
+        pv = self.variable("cache", "pages_value",
+                           _required_cache_leaf("pages_value"))
+        pt = self.variable("cache", "page_table",
+                           _required_cache_leaf("page_table"))
+        act = self.variable("cache", "active",
+                            _required_cache_leaf("active"))
+        ci = self.variable("cache", "index",
+                           _required_cache_leaf("index"))
+        pos, table, active = ci.value, pt.value, act.value
+        n_pages, H, page, D = pk.value.shape
+        n_ptab = table.shape[1]
+        if not isinstance(pos, jax.core.Tracer):
+            # eager misuse check, mirroring the dense vector path (the
+            # serving engine always runs this jitted and bound-checks
+            # host-side before dispatch)
+            live = jnp.where(jnp.asarray(active), jnp.asarray(pos), 0)
+            if int(jnp.max(live)) + s_new > max_len:
+                raise CacheOverflowError(
+                    f"paged decode at position {int(jnp.max(live))} with "
+                    f"{s_new} new token(s) exceeds max_seq={max_len}")
+        # clamped positions: identity for active rows (caller contract),
+        # keeps stale inactive rows inside every table (see docstring)
+        pos_safe = jnp.clip(pos, 0, max_len - s_new)
+        rope_row = jax.vmap(
+            lambda xb, p: apply_rope(xb[None], cos, sin, offset=p)[0])
+        q = rope_row(q, pos_safe)
+        k = rope_row(k, pos_safe)
+
+        # scatter through the table: token t of row b sits at global
+        # position g = pos[b]+t -> offset g%page of page table[b, g//page]
+        g = pos_safe[:, None] + jnp.arange(s_new)[None, :]       # [B, S]
+        phys = jnp.take_along_axis(
+            table, jnp.clip(g // page, 0, n_ptab - 1), axis=1)   # [B, S]
+        flat = phys * page + g % page
+        flat = jnp.where(active[:, None], flat, g % page)  # -> garbage pg
+
+        def scatter(pool, new):      # pool [P,H,page,D], new [B,H,S,D]
+            fp = pool.transpose(0, 2, 1, 3).reshape(n_pages * page, H, D)
+            upd = new.transpose(0, 2, 1, 3).reshape(b * s_new, H, D)
+            fp = fp.at[flat.reshape(-1)].set(upd.astype(pool.dtype))
+            return fp.reshape(n_pages, page, H, D).transpose(0, 2, 1, 3)
+        pk.value = scatter(pk.value, k)
+        pv.value = scatter(pv.value, v)
+        ci.value = pos + s_new   # engine masks/rolls back, as dense
+
+        # gather row b's logical view [H, n_ptab*page, D] (== max_seq
+        # positions: page_size divides max_seq by construction) and
+        # attend exactly like the dense vector path
+        def view(pool, row):
+            pages = jnp.take(pool, row, axis=0)    # [n_ptab, H, page, D]
+            return pages.transpose(1, 0, 2, 3).reshape(
+                H, n_ptab * page, D)
+        keys = jax.vmap(view, in_axes=(None, 0))(pk.value, table)
+        values = jax.vmap(view, in_axes=(None, 0))(pv.value, table)
+
+        scale = 1.0 / math.sqrt(d)
+        qpos = pos_safe[:, None] + jnp.arange(s_new)[None, :]    # [B, S]
+        mask = (jnp.arange(n_ptab * page)[None, None, :]
+                <= qpos[:, :, None])                     # [B, S, n_ptab*pg]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                            preferred_element_type=jnp.float32)
+        logits = jnp.where(mask[:, None], logits * scale, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd",
+                          probs.astype(self.dtype), values)
 
 
 class SwiGLU(nn.Module):
@@ -547,6 +677,50 @@ class TransformerLM(nn.Module):
         the result is recoverable via :func:`cache_max_seq`."""
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                             self.cache_shapes(batch_size, per_slot_index))
+
+    def paged_cache_shapes(self, n_slots: int, n_pages: int,
+                           page_size: int):
+        """Abstract pytree of the **block-paged** serving arena: per
+        block, a shared ``pages_key``/``pages_value`` pool of
+        ``[n_pages, H, page_size, head_dim]`` plus the per-slot
+        ``index`` [n_slots] — the layout
+        :meth:`Attention._paged_attend_slots` consumes (per-call
+        ``page_table``/``active`` leaves are inserted by the serving
+        engine, not stored).  Page 0 is reserved as the garbage page,
+        hence ``n_pages >= 2``; ``page_size`` must divide ``max_seq`` so
+        the gathered logical view covers exactly the rope table."""
+        if page_size < 1 or self.max_seq % page_size:
+            raise ValueError(
+                f"page_size must be >= 1 and divide max_seq="
+                f"{self.max_seq}, got {page_size}")
+        if n_pages < 2:
+            raise ValueError(f"n_pages must be >= 2 (page 0 is the "
+                             f"reserved garbage page), got {n_pages}")
+
+        def conv(tree):
+            if isinstance(tree, dict):
+                if "key" in tree and "index" in tree:
+                    _, H, _, D = tree["key"].shape
+                    return {
+                        "pages_key": jax.ShapeDtypeStruct(
+                            (n_pages, H, page_size, D),
+                            tree["key"].dtype),
+                        "pages_value": jax.ShapeDtypeStruct(
+                            (n_pages, H, page_size, D),
+                            tree["value"].dtype),
+                        "index": jax.ShapeDtypeStruct(
+                            (n_slots,), jnp.int32),
+                    }
+                return {k: conv(v) for k, v in tree.items()}
+            return tree
+        return conv(self.cache_shapes(1))
+
+    def init_paged_cache(self, n_slots: int, n_pages: int,
+                         page_size: int):
+        """Fresh zeroed paged arena (see :meth:`paged_cache_shapes`)."""
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            self.paged_cache_shapes(n_slots, n_pages,
+                                                    page_size))
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,

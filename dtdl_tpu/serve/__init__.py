@@ -16,10 +16,20 @@ verifies up to 4 drafted tokens per parameter sweep, losslessly
     sched = Scheduler(engine, draft=NGramDraft())   # the default source
     sched.submit(Request(prompt, 64, speculate=4))
 
+A **block-paged KV arena** with cross-request prefix caching is one
+constructor argument away — pages replace the dense per-slot rows, so a
+short request pins only the pages it reaches and identical prompt
+prefixes (system prompts) prefill ONCE and are shared read-only:
+
+    engine = InferenceEngine(model, params, n_slots=16,
+                             page_size=64, n_pages=256)   # overcommit
+    sched = Scheduler(engine)          # prefix_cache=True by default
+
 See engine.py (the compiled-program contract), scheduler.py (slot-based
-continuous batching + spec integration), draft.py (draft sources),
-sampling.py (per-slot greedy/temperature/top-k/top-p + the
-accept/resample kernel), metrics.py (async serving telemetry).
+continuous batching + spec integration), paged.py (page allocator +
+radix-style prefix cache), draft.py (draft sources), sampling.py
+(per-slot greedy/temperature/top-k/top-p + the accept/resample kernel),
+metrics.py (async serving telemetry).
 """
 
 from dtdl_tpu.serve.draft import (  # noqa: F401
@@ -29,6 +39,9 @@ from dtdl_tpu.serve.engine import (  # noqa: F401
     InferenceEngine, PromptTooLongError, default_buckets,
 )
 from dtdl_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from dtdl_tpu.serve.paged import (  # noqa: F401
+    GARBAGE_PAGE, PageAllocator, PagePoolExhaustedError,
+)
 from dtdl_tpu.serve.sampling import (  # noqa: F401
     GREEDY, SampleParams, accept_resample, filter_logits, sample,
 )

@@ -94,18 +94,52 @@ class ModelDraft:
     ``window`` context tokens: determinism is what makes the one-hot
     proposal treatment in ``accept_resample`` natural, and greedy small-
     model continuations are the classic draft (Leviathan et al. 2023).
-    The context is truncated to the largest power of two <= min(len,
-    window) so the :func:`generate` scan compiles once per (context
-    bucket, k) pair rather than per length.
+    BOTH scan dimensions are power-of-two bucketed so the compiled
+    family stays small: the context is truncated to the largest power
+    of two <= min(len, window), and the requested ``k`` is rounded UP
+    to a power of two before generating (greedy decoding is
+    prefix-stable, so generating the bucket and returning the first k
+    tokens proposes exactly the same drafts) — one program per
+    (ctx-bucket, k-bucket) pair instead of per (length, k).
+
+    ``warmup`` pre-compiles that whole family at CONSTRUCTION: pass the
+    request's maximum draft width (``speculate``; ``True`` means 8) and
+    every (ctx-bucket, k-bucket <= 2 * warmup) generate program is
+    traced on dummy tokens before the first request arrives — the
+    PR 4 known-remaining fix for demo-path first requests eating the
+    compile mid-traffic.  (The 2x headroom covers the scheduler asking
+    for ``gap + k`` tokens under harvest lag.)  Default 0 = lazy, the
+    right call when construction-time latency matters more than
+    first-request latency (tests).
     """
 
-    def __init__(self, model, params, window: int = 32):
+    def __init__(self, model, params, window: int = 32, warmup=0):
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         import flax.linen as nn
         self.model = model
         self.params = nn.unbox(params)
         self.window = min(window, model.max_seq - 1)
+        warmup = 8 if warmup is True else int(warmup)
+        if warmup < 0:
+            raise ValueError(f"warmup must be >= 0, got {warmup}")
+        if warmup:
+            k_hi = self._k_bucket(2 * warmup)
+            s0 = 1
+            while True:
+                kb = 1
+                while kb <= min(k_hi, model.max_seq - s0):
+                    self.propose(np.zeros(s0, np.int32), kb)
+                    kb *= 2
+                if s0 * 2 > self.window:
+                    break
+                s0 *= 2
+
+    def _k_bucket(self, k: int) -> int:
+        kb = 1
+        while kb < k:
+            kb *= 2
+        return kb
 
     def propose(self, ctx, k: int) -> np.ndarray:
         import jax.numpy as jnp
@@ -118,9 +152,9 @@ class ModelDraft:
         s0 = 1
         while s0 * 2 <= min(ctx.size, self.window):
             s0 *= 2
-        k = min(k, self.model.max_seq - s0)
-        if k < 1:
+        kb = min(self._k_bucket(k), self.model.max_seq - s0)
+        if kb < 1:
             return np.zeros((0,), np.int32)
         out = generate(self.model, self.params,
-                       jnp.asarray(ctx[None, ctx.size - s0:]), k)
-        return np.asarray(out)[0, s0:].astype(np.int32)
+                       jnp.asarray(ctx[None, ctx.size - s0:]), kb)
+        return np.asarray(out)[0, s0:s0 + min(k, kb)].astype(np.int32)

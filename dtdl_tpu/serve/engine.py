@@ -45,13 +45,25 @@ model (the Orca/vLLM decomposition, rebuilt XLA-native on static shapes):
   same read into up to k+1 tokens while staying token-losslessly
   equivalent (SCALING.md "Speculative decoding arithmetic").
 
-The **arena** is the fixed [n_slots, H, max_seq, head_dim] per-block K/V
-buffer pair plus a per-slot position vector (``cache_shapes(...,
-per_slot_index=True)``).  It is donated to both programs, so the cache
-is updated in place on device — no per-step reallocation of the largest
-buffer in serving.  Sampling knobs ride along as per-slot device arrays
-(dtdl_tpu/serve/sampling.py), so greedy and nucleus requests share the
-same compiled step.
+The **arena** comes in two layouts.  Dense (default): the fixed
+[n_slots, H, max_seq, head_dim] per-block K/V buffer pair plus a
+per-slot position vector (``cache_shapes(..., per_slot_index=True)``)
+— every slot charged max_seq worth of KV bytes up front.  **Paged**
+(``page_size > 0``): a fixed pool of [n_pages, H, page_size, head_dim]
+pages that per-slot page tables map logical positions onto
+(models/transformer.py:_paged_attend_slots), so a slot pins only the
+pages its sequence has reached (fragmentation < page_size tokens/slot)
+and identical prompt prefixes can SHARE read-only pages across requests
+(the scheduler's prefix cache, dtdl_tpu/serve/paged.py) — far more
+concurrent slots per HBM byte, and cache-hit prompts skip the shared
+prefix's prefill entirely.  Crucially the paged layout reuses the SAME
+three program families: page tables and the active mask are plain data
+inputs, and a prefix-hit prefill re-enters through the suffix's
+(smaller) bucket.  Either arena is donated to every program, so the
+cache is updated in place on device — no per-step reallocation of the
+largest buffer in serving.  Sampling knobs ride along as per-slot
+device arrays (dtdl_tpu/serve/sampling.py), so greedy and nucleus
+requests share the same compiled step.
 
 The engine is the functional core: it owns the model, the (unboxed)
 params, and the compile caches, and threads ``(arena, last_tokens)``
@@ -93,13 +105,59 @@ def default_buckets(max_seq: int, start: int = 16) -> tuple[int, ...]:
     return tuple(out)
 
 
+def _paged_cache(arena, page_table, active, index=None):
+    """Insert the per-call data leaves (page tables + active mask, and
+    optionally an index override) into every block's attn cache dict of
+    a paged arena — the leaves :meth:`Attention._paged_attend_slots`
+    reads but the arena does not store (they are inputs, re-supplied by
+    the host each dispatch; remapping pages never recompiles)."""
+    def conv(tree):
+        if isinstance(tree, dict):
+            if "pages_key" in tree:
+                out = dict(tree, page_table=page_table, active=active)
+                if index is not None:
+                    out["index"] = index
+                return out
+            return {k: conv(v) for k, v in tree.items()}
+        return tree
+    return conv(arena)
+
+
+def _strip_paged(cache):
+    """Drop the per-call leaves back out of a mutated paged cache so the
+    returned arena keeps the stable pool+index structure."""
+    def conv(tree):
+        if isinstance(tree, dict):
+            if "pages_key" in tree:
+                return {k: v for k, v in tree.items()
+                        if k not in ("page_table", "active")}
+            return {k: conv(v) for k, v in tree.items()}
+        return tree
+    return conv(cache)
+
+
 class InferenceEngine:
     """Compiled prefill/decode pair over a slotted KV arena (see module
     docstring).  ``n_slots`` is the decode batch width — the one shape
-    the decode program is specialized to."""
+    the decode program is specialized to.
+
+    ``page_size > 0`` switches the arena to the **block-paged** layout:
+    instead of ``[n_slots, max_seq]`` K/V rows, a pool of ``n_pages``
+    pages of ``page_size`` tokens each (page 0 reserved as the garbage
+    page) that per-slot page tables map logical positions onto.  The
+    SAME three program families serve both layouts — page tables and
+    the active mask enter decode/verify as plain int32/bool inputs, and
+    prefill takes the slot's table row plus a ``start`` offset (the
+    prefix-cached token count), so a prefix-cache hit re-enters through
+    a *smaller suffix bucket* instead of a new program.  ``n_pages``
+    defaults to dense-equivalent capacity
+    (``n_slots * max_seq / page_size + 1``); undersizing it overcommits
+    HBM and shifts admission to the scheduler's page accounting
+    (dtdl_tpu/serve/paged.py)."""
 
     def __init__(self, model, params, n_slots: int = 8, buckets=None,
-                 observer=None):
+                 observer=None, page_size: int = 0,
+                 n_pages: int | None = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.model = model
@@ -116,16 +174,42 @@ class InferenceEngine:
         if self.buckets[-1] > model.max_seq:
             raise ValueError(f"bucket {self.buckets[-1]} exceeds "
                              f"max_seq={model.max_seq}")
-        # single-row cache template the prefill program zero-fills
+        self.paged = page_size > 0
+        self.page_size = page_size
+        if self.paged:
+            if model.max_seq % page_size:
+                raise ValueError(f"page_size={page_size} must divide "
+                                 f"max_seq={model.max_seq}")
+            self.n_ptab = model.max_seq // page_size
+            self.n_pages = (n_pages if n_pages is not None
+                            else n_slots * self.n_ptab + 1)
+            if self.n_pages < 2:
+                raise ValueError(f"n_pages must be >= 2, got "
+                                 f"{self.n_pages}")
+        else:
+            if n_pages is not None:
+                raise ValueError("n_pages requires page_size > 0")
+            self.n_ptab = 0
+            self.n_pages = 0
+        # single-row cache template the dense prefill program zero-fills
         self._cache1 = model.cache_shapes(1)
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None
         self._verify_fns: dict[int, object] = {}
+        # dispatch counters (NOT in compile_stats, which must stay
+        # constant across calls): prefill invocations per bucket — the
+        # FLOP receipt prefix-cache tests read, since prefill compute
+        # is proportional to sum(bucket * calls)
+        self.prefill_calls: dict[int, int] = {}
 
     # ---- state the caller threads ------------------------------------
 
     def init_arena(self):
-        """Fresh zeroed [n_slots] KV arena (donated to every program)."""
+        """Fresh zeroed KV arena (donated to every program): dense
+        [n_slots, max_seq] rows, or the paged pool + per-slot indices."""
+        if self.paged:
+            return self.model.init_paged_cache(
+                self.n_slots, self.n_pages, self.page_size)
         return self.model.init_cache(self.n_slots, per_slot_index=True)
 
     def init_last_tokens(self):
@@ -177,19 +261,63 @@ class InferenceEngine:
 
         return jax.jit(prefill, donate_argnums=(1,))
 
-    def _build_decode(self):
+    def _build_prefill_paged(self, T: int):
         model = self.model
 
-        def decode(params, arena, last, active, key, temp, top_k, top_p):
+        def prefill(params, arena, last, tokens, length, slot, start,
+                    page_row, key, temp, top_k, top_p):
+            # a single-row paged view over the SHARED (donated) pool:
+            # the slot's table row, index at `start` (= the number of
+            # prefix-cached tokens already resident in shared pages) —
+            # the suffix attends the cached prefix through the same
+            # gather path decode uses, which is what makes a prefix hit
+            # a smaller-bucket prefill instead of a new program family
+            cache = _paged_cache(arena, page_row[None],
+                                 jnp.ones((1,), bool),
+                                 index=start[None])
+            hidden, muts = model.apply(
+                {"params": params, "cache": cache}, tokens, decode=True,
+                return_hidden=True, mutable=["cache"])
+            # logits of the last REAL suffix position only
+            h_last = jax.lax.dynamic_slice_in_dim(
+                hidden, length - 1, 1, axis=1)[:, 0]           # [1, D]
+            logits = jnp.einsum(
+                "bd,vd->bv", h_last,
+                params["embed"].astype(model.dtype)).astype(jnp.float32)
+            tok = sample(logits, key, temp, top_k, top_p)      # [1]
+            new_cache = _strip_paged(muts["cache"])
+
+            def write(a, n):
+                if a.ndim == 1:   # [n_slots] index: start + true length
+                    return jax.lax.dynamic_update_slice(
+                        a, (start + length)[None].astype(a.dtype),
+                        (slot,))
+                return n          # the pool, updated through the table
+            arena = jax.tree.map(write, arena, new_cache)
+            last = jax.lax.dynamic_update_slice(last, tok, (slot,))
+            return arena, last, logits[0]
+
+        return jax.jit(prefill, donate_argnums=(1,))
+
+    def _build_decode(self):
+        model, paged = self.model, self.paged
+
+        def decode(params, arena, last, active, tables, key, temp,
+                   top_k, top_p):
+            cache = (_paged_cache(arena, tables, active) if paged
+                     else arena)
             logits, muts = model.apply(
-                {"params": params, "cache": arena}, last[:, None],
+                {"params": params, "cache": cache}, last[:, None],
                 decode=True, mutable=["cache"])
+            new_cache = (_strip_paged(muts["cache"]) if paged
+                         else muts["cache"])
 
             def fix(old, new):
                 if old.ndim == 1:   # index: only active slots advance
                     return jnp.where(active, new, old)
                 return new          # garbage K/V writes into dead slots
-            arena = jax.tree.map(fix, arena, muts["cache"])
+            arena = jax.tree.map(fix, arena, new_cache)  # (paged: routed
+            # to the garbage page inside the model, never a live page)
 
             lg = logits[:, 0].astype(jnp.float32)              # [B, V]
             tok = sample(lg, key, temp, top_k, top_p)
@@ -199,17 +327,21 @@ class InferenceEngine:
         return jax.jit(decode, donate_argnums=(1,))
 
     def _build_verify(self, k: int):
-        model = self.model
+        model, paged = self.model, self.paged
 
-        def verify(params, arena, last, draft, draft_len, active, key,
-                   temp, top_k, top_p):
+        def verify(params, arena, last, draft, draft_len, active,
+                   tables, key, temp, top_k, top_p):
             # the slots' pre-step cache positions: every block's index
             # leaf carries the same per-slot values, take the first
             pos = next(l for l in jax.tree.leaves(arena) if l.ndim == 1)
+            cache = (_paged_cache(arena, tables, active) if paged
+                     else arena)
             x = jnp.concatenate([last[:, None], draft], axis=1)  # [B,k+1]
             logits, muts = model.apply(
-                {"params": params, "cache": arena}, x, decode=True,
+                {"params": params, "cache": cache}, x, decode=True,
                 mutable=["cache"])
+            new_cache = (_strip_paged(muts["cache"]) if paged
+                         else muts["cache"])
             tokens, n_acc = accept_resample(
                 logits.astype(jnp.float32), draft, draft_len, key,
                 temp, top_k, top_p)
@@ -221,7 +353,7 @@ class InferenceEngine:
                     # committed n_accepted+1; inactive slots stay put
                     return jnp.where(active, pos + n_em, old)
                 return new      # garbage K/V past the committed index is
-            arena = jax.tree.map(fix, arena, muts["cache"])  # overwritten
+            arena = jax.tree.map(fix, arena, new_cache)  # overwritten
             # before it is attended (see module docstring)
             new_last = jnp.take_along_axis(
                 tokens, n_acc[:, None], axis=1)[:, 0]
@@ -236,7 +368,13 @@ class InferenceEngine:
         """Compiled-program counts — the no-per-request-recompile
         receipt: one entry per touched prefill bucket, one per touched
         verify draft-width bucket, one decode program, each with a jit
-        cache size that must stay 1."""
+        cache size that must stay 1.  ``paged`` carries the arena
+        layout (None = dense; else page geometry): the SAME program
+        families serve both layouts, so a paged engine's receipt is the
+        same shape as a dense one's — page tables are data, not shapes.
+        (Per-call occupancy — pages_in_use, prefix hit rates — is
+        scheduler state, reported by ServeMetrics; this dict stays
+        constant across calls so receipts can be compared.)"""
         def n(f):
             try:
                 return f._cache_size()
@@ -244,54 +382,127 @@ class InferenceEngine:
                 return -1
         return {"prefill": {T: n(f) for T, f in self._prefill_fns.items()},
                 "decode": n(self._decode_fn) if self._decode_fn else 0,
-                "verify": {k: n(f) for k, f in self._verify_fns.items()}}
+                "verify": {k: n(f) for k, f in self._verify_fns.items()},
+                "paged": ({"page_size": self.page_size,
+                           "n_pages": self.n_pages,
+                           "pages_per_slot": self.n_ptab}
+                          if self.paged else None)}
 
     # ---- the two entry points ----------------------------------------
 
     def prefill(self, arena, last_tokens, slot: int, prompt,
-                sampling: SampleParams = SampleParams(), key=None):
+                sampling: SampleParams = SampleParams(), key=None,
+                page_row=None, start: int = 0):
         """Admit ``prompt`` into arena row ``slot``; returns the updated
         ``(arena, last_tokens, logits[V])`` — ``last_tokens[slot]`` is
-        the request's first sampled token."""
+        the request's first sampled token.
+
+        Paged engines take two extras: ``page_row`` — the slot's
+        [pages_per_slot] int32 page table row (prefix-cache-hit pages
+        first, freshly allocated pages for the rest of the prompt,
+        garbage-page 0 beyond) — and ``start``, the number of
+        prefix-cached tokens already resident in shared pages
+        (page-aligned).  ``prompt`` is then only the UNCACHED suffix:
+        the program re-enters through the suffix's (smaller) bucket,
+        which is exactly the prefill-FLOPs-skipped win a cache hit
+        buys (see ``prefill_calls``)."""
         prompt = np.asarray(prompt, np.int32).ravel()
         if prompt.size < 1:
             raise ValueError("empty prompt")
-        if prompt.size > self.max_seq:
-            raise ValueError(f"prompt length {prompt.size} exceeds "
-                             f"max_seq={self.max_seq}")
         if not 0 <= slot < self.n_slots:
             raise ValueError(f"slot {slot} out of range "
                              f"[0, {self.n_slots})")
+        if self.paged:
+            if page_row is None:
+                raise ValueError("paged engine prefill needs the slot's "
+                                 "page_row (see Scheduler)")
+            if start % self.page_size or start < 0:
+                raise ValueError(f"start={start} must be a non-negative "
+                                 f"multiple of page_size="
+                                 f"{self.page_size}")
+            page_row = np.asarray(page_row, np.int32).ravel()
+            if page_row.size != self.n_ptab:
+                raise ValueError(f"page_row must have {self.n_ptab} "
+                                 f"entries, got {page_row.size}")
+        elif page_row is not None or start:
+            raise ValueError("page_row/start require a paged engine "
+                             "(page_size > 0)")
+        if start + prompt.size > self.max_seq:
+            raise ValueError(f"prompt length {start + prompt.size} "
+                             f"exceeds max_seq={self.max_seq}")
         T = self.bucket_for(prompt.size)
+        if start + T > self.max_seq:
+            # the PADDED window must fit too: the kernel clamps pos to
+            # max_seq - T, so an overshooting bucket would silently
+            # shift the whole write window backward over cached prefix
+            # pages.  The scheduler caps prefix hits so this never
+            # fires (_admit); reaching it means a caller supplied its
+            # own too-large start.
+            raise ValueError(
+                f"prefix start {start} + padded bucket {T} exceeds "
+                f"max_seq={self.max_seq}; map fewer prefix pages so "
+                f"the suffix bucket fits")
         if T not in self._prefill_fns:
-            fn = self._build_prefill(T)
+            fn = (self._build_prefill_paged(T) if self.paged
+                  else self._build_prefill(T))
             if self.observer is not None:
                 fn = self.observer.watch(fn, f"serve.prefill[{T}]")
             self._prefill_fns[T] = fn
+        self.prefill_calls[T] = self.prefill_calls.get(T, 0) + 1
         padded = np.zeros((1, T), np.int32)
         padded[0, :prompt.size] = prompt
         key = jax.random.PRNGKey(0) if key is None else key
-        arena, last, logits = self._prefill_fns[T](
-            self.params, arena, last_tokens, jnp.asarray(padded),
-            jnp.asarray(prompt.size, jnp.int32),
-            jnp.asarray(slot, jnp.int32), key, *pack([sampling]))
+        if self.paged:
+            arena, last, logits = self._prefill_fns[T](
+                self.params, arena, last_tokens, jnp.asarray(padded),
+                jnp.asarray(prompt.size, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(start, jnp.int32), jnp.asarray(page_row),
+                key, *pack([sampling]))
+        else:
+            arena, last, logits = self._prefill_fns[T](
+                self.params, arena, last_tokens, jnp.asarray(padded),
+                jnp.asarray(prompt.size, jnp.int32),
+                jnp.asarray(slot, jnp.int32), key, *pack([sampling]))
         return arena, last, logits
 
-    def decode(self, arena, last_tokens, active, key, temp, top_k, top_p):
+    def _tables_arg(self, page_tables):
+        """Validate/normalize the decode/verify page-tables input: the
+        [n_slots, pages_per_slot] int32 map for paged engines, a scalar
+        placeholder (unused in the trace) for dense ones."""
+        if not self.paged:
+            if page_tables is not None:
+                raise ValueError("page_tables require a paged engine")
+            return jnp.zeros((), jnp.int32)
+        if page_tables is None:
+            raise ValueError("paged engine needs page_tables (see "
+                             "Scheduler)")
+        page_tables = jnp.asarray(page_tables, jnp.int32)
+        if page_tables.shape != (self.n_slots, self.n_ptab):
+            raise ValueError(f"page_tables must be [{self.n_slots}, "
+                             f"{self.n_ptab}], got {page_tables.shape}")
+        return page_tables
+
+    def decode(self, arena, last_tokens, active, key, temp, top_k,
+               top_p, page_tables=None):
         """One token for every active slot; ``active`` is a [n_slots]
         bool mask (a runtime value — occupancy never recompiles).
-        Returns ``(arena, last_tokens, logits[n_slots, V])``."""
+        Paged engines additionally take the [n_slots, pages_per_slot]
+        ``page_tables`` (data, re-supplied each call — remapping never
+        recompiles).  Returns ``(arena, last_tokens,
+        logits[n_slots, V])``."""
         if self._decode_fn is None:
             fn = self._build_decode()
             if self.observer is not None:
                 fn = self.observer.watch(fn, "serve.decode")
             self._decode_fn = fn
         return self._decode_fn(self.params, arena, last_tokens,
-                               jnp.asarray(active), key, temp, top_k,
-                               top_p)
+                               jnp.asarray(active),
+                               self._tables_arg(page_tables), key,
+                               temp, top_k, top_p)
 
     def verify(self, arena, last_tokens, draft_tokens, draft_len, active,
-               key, temp, top_k, top_p):
+               key, temp, top_k, top_p, page_tables=None):
         """One speculative verify pass over every slot: score each slot's
         ``draft_len[b]`` candidate tokens (``draft_tokens[b, :]``, zero-
         padded to the program's width k) in one parameter sweep, accept a
@@ -326,5 +537,5 @@ class InferenceEngine:
             self._verify_fns[k] = fn
         return self._verify_fns[k](
             self.params, arena, last_tokens, draft_tokens,
-            jnp.asarray(draft_len, jnp.int32), jnp.asarray(active), key,
-            temp, top_k, top_p)
+            jnp.asarray(draft_len, jnp.int32), jnp.asarray(active),
+            self._tables_arg(page_tables), key, temp, top_k, top_p)

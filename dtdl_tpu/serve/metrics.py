@@ -64,6 +64,18 @@ class ServeMetrics:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.draft_s = 0.0
+        # paged KV arena (dtdl_tpu/serve/paged.py): prefix-cache hit
+        # accounting over FULL prompt pages, prefill tokens the cache
+        # let the engine skip, page-pool occupancy (host counters the
+        # scheduler already knows — no device reads), and requests shed
+        # when the pool could not grow a mid-flight sequence
+        self.n_shed = 0
+        self.prefix_hit_pages = 0
+        self.prefix_full_pages = 0
+        self.prefill_tokens_saved = 0
+        self.pages_in_use_peak = 0
+        self.pages_in_use_last = 0
+        self.page_capacity = 0
         self.ttft_s: list[float] = []          # exact samples, capped
         self.tok_latency_s: list[float] = []   # per-request mean, capped
         # streaming stats (fixed memory, never capped): means AND tails
@@ -105,6 +117,32 @@ class ServeMetrics:
         stays an engine-health signal and ``requests_submitted`` (which
         ``on_submit`` already incremented) is not double-counted."""
         self.n_aborted += 1
+
+    def on_shed(self, req):
+        """Page-pool exhaustion shed: the request was mid-flight when
+        the pool could not supply a page for its next write window and
+        no cached page was evictable — retired with ``req.error`` set
+        (its pages freed; the run continues).  A capacity signal, kept
+        apart from ``requests_failed`` (engine health) and
+        ``requests_expired`` (per-request deadlines)."""
+        self.n_shed += 1
+
+    def on_prefix(self, hit_pages: int, full_pages: int,
+                  tokens_saved: int):
+        """One admission's prefix-cache outcome: of ``full_pages`` full
+        prompt pages, ``hit_pages`` leading ones were already resident
+        (mapped read-only, ``tokens_saved`` prompt tokens skipped
+        prefill entirely)."""
+        self.prefix_hit_pages += hit_pages
+        self.prefix_full_pages += full_pages
+        self.prefill_tokens_saved += tokens_saved
+
+    def on_pages(self, pages_in_use: int, capacity: int):
+        """Page-pool occupancy after a scheduler step (host-side
+        allocator state, like slot occupancy — never a device read)."""
+        self.pages_in_use_last = pages_in_use
+        self.pages_in_use_peak = max(self.pages_in_use_peak, pages_in_use)
+        self.page_capacity = capacity
 
     def on_draft(self, seconds: float):
         """One drafting phase's host time (dispatch-side; drafted/
@@ -196,6 +234,16 @@ class ServeMetrics:
             "tokens_per_step_mean": round(
                 decode_tokens / self.n_decode_steps, 4)
             if self.n_decode_steps else 0.0,
+            "requests_shed": self.n_shed,
+            # paged KV / prefix cache (all zeros for a dense arena):
+            # hit rate is over FULL prompt pages — the unit of sharing
+            "prefix_hit_rate": round(
+                self.prefix_hit_pages / self.prefix_full_pages, 4)
+            if self.prefix_full_pages else 0.0,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "pages_in_use_peak": self.pages_in_use_peak,
+            "pages_in_use_last": self.pages_in_use_last,
+            "page_capacity": self.page_capacity,
             "spec_steps": self.n_verify_steps,
             "spec_steps_by_k": dict(self.verify_steps_by_k),
             "spec_drafted_tokens": self.spec_drafted,

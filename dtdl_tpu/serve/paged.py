@@ -1,0 +1,210 @@
+"""Host-side page bookkeeping for the block-paged KV arena.
+
+The serving engine's dense arena charged every slot ``max_seq`` worth of
+KV bytes up front; the paged arena (dtdl_tpu/serve/engine.py with
+``page_size > 0``) carves the same HBM into a fixed pool of
+``page_size``-token pages and maps each slot's logical positions onto
+physical pages through a per-slot page table.  Everything DEVICE-side is
+data — the pool and per-slot indices live in the donated arena, the page
+tables ride into the compiled programs as plain int32 inputs — so all
+allocation *policy* lives here, on the host, where the scheduler already
+tracks every slot's worst-case position without syncing
+(scheduler._SlotState.pos_hi).  Nothing in this module touches jax.
+
+Two responsibilities, one class:
+
+* **Page allocation** — a free list over physical pages 1..n_pages-1
+  (page 0 is the reserved *garbage page*: every unmapped page-table
+  entry points at it, and the compiled programs route inactive slots'
+  writes there, so a stale table row can never corrupt a live page).
+  A slot acquires pages lazily as its worst-case index crosses page
+  boundaries; at retirement its private pages return to the free list
+  immediately.  Fragmentation is bounded by construction: a slot wastes
+  at most ``page_size - 1`` positions (its last partial page) instead
+  of ``max_seq - seq_len``.
+
+* **Prefix caching** — a radix-style content index over FULL prompt
+  pages.  Page i of a prompt is keyed by the *chained* hash of tokens
+  ``[0, (i+1)·page_size)``: chaining is a correctness requirement, not a
+  convenience — K/V at position j depends (causally) on every token
+  ``<= j``, so a page is reusable exactly when its whole token prefix
+  matches.  The chain of hashes IS a radix tree over page-granular
+  token paths, stored flat.  A new prompt walks the chain from page 0;
+  the longest cached run maps **read-only shared** pages (refcounted)
+  and only the suffix is prefilled — near-zero TTFT on cache-hit
+  prompts.  Sharing is divergence-safe by construction: hits are capped
+  at ``(prompt_len - 1) // page_size`` full pages, so the write
+  frontier (the remaining prompt tokens and every decoded token) always
+  lands on a freshly-allocated *private* page — copy-on-write realized
+  as recompute-on-write of at most one page's suffix, which is what
+  keeps the device side free of any page-copy program.
+
+  Eviction is LRU over refcount-zero cached pages only: a page mapped
+  by any live slot is pinned however cold its hash is; a cached page
+  nobody maps stays warm (serving later hits) until the free list runs
+  dry and it is the least-recently-released one.
+
+When neither the free list nor the evictable set can supply a page,
+:class:`PagePoolExhaustedError` is raised — the scheduler turns that
+into bounded behavior (admission backpressure, or a named shed of the
+growing request) instead of an unbounded stall.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Optional, Sequence
+
+GARBAGE_PAGE = 0
+
+
+class PagePoolExhaustedError(RuntimeError):
+    """Every usable page is pinned by a live request (nothing evictable).
+
+    Raised by :meth:`PageAllocator.alloc`; the scheduler converts it
+    into backpressure at admission (the request waits for retirements)
+    or a named shed of a mid-flight request that outgrew the pool
+    (``Request.error`` set, its pages freed, the run continues).
+    """
+
+
+class PageAllocator:
+    """Free-list page allocator + chained-hash prefix cache (see module
+    docstring).  Page 0 is reserved as the garbage page and never
+    allocated."""
+
+    def __init__(self, n_pages: int, page_size: int,
+                 prefix_cache: bool = True):
+        if n_pages < 2:
+            raise ValueError(f"n_pages must be >= 2 (page 0 is the "
+                             f"reserved garbage page), got {n_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.prefix_cache = prefix_cache
+        self._free: deque[int] = deque(range(1, n_pages))
+        self._ref: dict[int, int] = {}          # page -> live references
+        self._cached: dict[int, int] = {}       # chain hash -> page
+        self._page_hash: dict[int, int] = {}    # page -> chain hash
+        # refcount-0 cached pages, least-recently-released first
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # counters for ServeMetrics / bench receipts
+        self.prefix_hit_pages = 0
+        self.prefix_miss_pages = 0
+        self.evictions = 0
+
+    # ---- accounting ---------------------------------------------------
+
+    @property
+    def pages_in_use(self) -> int:
+        """Pages currently referenced by at least one live slot."""
+        return len(self._ref)
+
+    @property
+    def available(self) -> int:
+        """Pages an alloc() could return right now (free + evictable)."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def capacity(self) -> int:
+        """Usable pages (the pool minus the reserved garbage page)."""
+        return self.n_pages - 1
+
+    # ---- allocation ---------------------------------------------------
+
+    def alloc(self) -> int:
+        """One private page (refcount 1), evicting the LRU refcount-zero
+        cached page if the free list is dry."""
+        if self._free:
+            page = self._free.popleft()
+        elif self._lru:
+            page, _ = self._lru.popitem(last=False)
+            h = self._page_hash.pop(page)
+            del self._cached[h]
+            self.evictions += 1
+        else:
+            raise PagePoolExhaustedError(
+                f"page pool exhausted: all {self.capacity} pages "
+                f"(page_size={self.page_size}) are pinned by live "
+                f"requests")
+        self._ref[page] = 1
+        return page
+
+    def acquire(self, page: int) -> None:
+        """Add a reference to a cached page (a prefix hit mapping it
+        read-only into another slot's table)."""
+        if page not in self._ref:
+            self._lru.pop(page, None)        # was evictable; now pinned
+            self._ref[page] = 1
+        else:
+            self._ref[page] += 1
+
+    def release(self, page: int) -> None:
+        """Drop one reference; at zero a cached page becomes evictable
+        (kept warm for future hits), a private page frees immediately."""
+        n = self._ref[page] - 1
+        if n > 0:
+            self._ref[page] = n
+            return
+        del self._ref[page]
+        if page in self._page_hash:
+            self._lru[page] = None           # most-recently released
+        else:
+            self._free.append(page)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    # ---- the prefix cache ---------------------------------------------
+
+    def page_hashes(self, tokens: Sequence[int]) -> list[int]:
+        """Chained hashes of every FULL page of ``tokens`` — entry i
+        keys tokens [0, (i+1)·page_size), so equal hash i means equal
+        whole prefix, which is exactly the K/V-reuse condition."""
+        pg = self.page_size
+        out, h = [], 0
+        for i in range(len(tokens) // pg):
+            h = hash((h, tuple(int(t) for t in tokens[i * pg:(i + 1) * pg])))
+            out.append(h)
+        return out
+
+    def match_prefix(self, prompt: Sequence[int]) -> list[int]:
+        """Longest cached run of full prompt pages from page 0, capped
+        at ``(len(prompt) - 1) // page_size`` so at least one prompt
+        token is always prefilled (the write frontier stays private and
+        the first output token has a program to come from).  Returns the
+        physical pages WITHOUT acquiring them."""
+        if not self.prefix_cache:
+            return []
+        cap = (len(prompt) - 1) // self.page_size
+        pages = []
+        for h in self.page_hashes(prompt)[:cap]:
+            page = self._cached.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def register(self, h: int, page: int) -> None:
+        """Publish a freshly-prefilled full prompt page under its chain
+        hash.  First writer wins — a hash already cached keeps its
+        original page (the contents are identical by construction, and
+        re-pointing would orphan the original's refcounts)."""
+        if not self.prefix_cache or h in self._cached:
+            return
+        self._cached[h] = page
+        self._page_hash[page] = h
+
+    def cached_pages(self) -> int:
+        return len(self._cached)
+
+    def reset(self) -> None:
+        """Forget everything — the engine-failure containment path: a
+        re-initialized arena invalidates every cached page's contents,
+        so serving a stale hit would be silent corruption."""
+        self._free = deque(range(1, self.n_pages))
+        self._ref.clear()
+        self._cached.clear()
+        self._page_hash.clear()
+        self._lru.clear()

@@ -56,6 +56,22 @@ scheduler absorbs:
   (non-speculative slots ride along with ``draft_len=0`` and behave
   exactly like a decode step — token-identical, pinned by
   tests/test_spec_decode.py).
+
+**Paged KV** (an engine built with ``page_size > 0``) moves the
+admission currency from slots to PAGES.  The scheduler owns the
+host-side :class:`~dtdl_tpu.serve.paged.PageAllocator` (free list +
+chained-hash prefix cache over full prompt pages): admission maps the
+longest cached prompt-prefix read-only (shared, refcounted) and
+prefills only the suffix through its (smaller) bucket — the TTFT win —
+waiting in FIFO order when the pool cannot map the prompt yet; decode
+growth allocates pages from the same worst-case ``pos_hi`` arithmetic
+the overflow settling uses (no device reads, no new programs — the
+fresh page table rides into the next dispatch as data); retirement
+releases pages immediately (cached prefix pages stay warm, evictable
+LRU).  A mid-flight slot the pool cannot grow for is shed with the
+named :class:`~dtdl_tpu.serve.paged.PagePoolExhaustedError` message
+(``requests_shed``) rather than stalling the batch.  Token streams are
+identical to the dense arena's, pinned by tests/test_paged_kv.py.
 """
 
 from __future__ import annotations
@@ -73,6 +89,8 @@ from dtdl_tpu.obs.observer import NULL_OBSERVER
 from dtdl_tpu.serve.draft import DraftSource, NGramDraft
 from dtdl_tpu.serve.engine import InferenceEngine, PromptTooLongError
 from dtdl_tpu.serve.metrics import ServeMetrics
+from dtdl_tpu.serve.paged import (GARBAGE_PAGE, PageAllocator,
+                                  PagePoolExhaustedError)
 from dtdl_tpu.serve.sampling import GREEDY, SampleParams
 
 _ids = itertools.count()
@@ -201,7 +219,8 @@ class Scheduler:
     def __init__(self, engine: InferenceEngine, seed: int = 0,
                  harvest_lag: int = 4, metrics: ServeMetrics = None,
                  observer=None, draft: Optional[DraftSource] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 prefix_cache: bool = True):
         if harvest_lag < 0:
             raise ValueError(f"harvest_lag must be >= 0, got "
                              f"{harvest_lag}")
@@ -247,6 +266,21 @@ class Scheduler:
         # request is submitted, so the per-step queue/slot scan is free
         # for the (default) deadline-less workload
         self._deadlines_seen = False
+        # paged KV arena (dtdl_tpu/serve/paged.py): host-side page
+        # allocator + prefix cache, the per-slot page tables the
+        # compiled programs consume as data, and the per-slot page
+        # lists for release at retirement.  Admission is gated on FREE
+        # PAGES, not free slots: a free slot whose prompt cannot be
+        # mapped waits in the queue (FIFO backpressure) until
+        # retirements free pages or the prefix cache eats the need.
+        self.pages: Optional[PageAllocator] = None
+        if engine.paged:
+            self.pages = PageAllocator(engine.n_pages, engine.page_size,
+                                       prefix_cache=prefix_cache)
+            self._ptab = np.full((engine.n_slots, engine.n_ptab),
+                                 GARBAGE_PAGE, np.int32)
+            self._slot_pages: list[list[int]] = \
+                [[] for _ in range(engine.n_slots)]
 
     # ---- intake -------------------------------------------------------
 
@@ -293,6 +327,17 @@ class Scheduler:
             self.engine.bucket_for(prompt_len)
         except PromptTooLongError as e:
             return self._reject(req, str(e))
+        if self.pages is not None:
+            # never-fits guard: a prompt whose pages (plus the first
+            # generated token's) exceed the whole pool would wait at
+            # admission forever — shed it NOW with the diagnosis
+            pg = self.engine.page_size
+            need = (prompt_len + 1 + pg - 1) // pg
+            if need > self.pages.capacity:
+                return self._reject(
+                    req, f"page pool exhausted: prompt needs {need} "
+                         f"pages (page_size={pg}) but the pool has "
+                         f"only {self.pages.capacity}")
         if req.deadline_s is not None:
             self._deadlines_seen = True
         self._reqs[req.rid] = req
@@ -317,6 +362,18 @@ class Scheduler:
         req._retired = True
         self.slots[slot] = None
         self._active[slot] = False
+        if self.pages is not None:
+            # release the slot's pages (cached prefix pages become
+            # evictable, private pages free immediately) and point the
+            # stale table row at the garbage page — any still-in-flight
+            # step for this slot was dispatched with its own table
+            # snapshot, and the single device stream orders it before
+            # whatever prefill reuses the pages (the same
+            # overwritten-after-retire discipline as the dense arena)
+            for p in self._slot_pages[slot]:
+                self.pages.release(p)
+            self._slot_pages[slot] = []
+            self._ptab[slot] = GARBAGE_PAGE
 
     def _expire(self):
         """Deadline watchdog: retire any request past its wall-clock
@@ -390,6 +447,12 @@ class Scheduler:
                     self.metrics.on_failure)
         self.arena = self.engine.init_arena()
         self.last_tokens = self.engine.init_last_tokens()
+        if self.pages is not None:
+            # the re-initialized arena invalidated every page's
+            # contents — a stale prefix hit would be silent corruption
+            self.pages.reset()
+            self._ptab[:] = GARBAGE_PAGE
+            self._slot_pages = [[] for _ in range(self.engine.n_slots)]
 
     def _admit(self):
         if self._closed:
@@ -397,12 +460,56 @@ class Scheduler:
         for slot in range(self.engine.n_slots):
             if self.slots[slot] is not None or not self.queue:
                 continue
-            req = self.queue.popleft()
+            req = self.queue[0]
+            suffix, start, row = req.prompt, 0, None
+            hits, fresh, hashes = [], [], []
+            if self.pages is not None:
+                # paged admission: gate on FREE PAGES.  Match the
+                # longest cached run of full prompt pages (mapped
+                # read-only, shared), allocate private pages for the
+                # rest, and prefill only the uncached suffix — the
+                # prefix-cache TTFT win.  A prompt the pool cannot map
+                # right now WAITS (FIFO backpressure; retirements free
+                # pages) instead of stealing a slot it cannot fill.
+                pg = self.engine.page_size
+                prompt = [int(t) for t in req.prompt]
+                hits = self.pages.match_prefix(prompt)
+                # the suffix's PADDED bucket must also fit max_seq —
+                # the kernel clamps an overshooting window backward,
+                # which would scatter over the cached pages themselves.
+                # Dropping trailing hits grows the suffix (monotonic:
+                # zero hits == the submit-checked full prompt), so this
+                # always terminates on a valid configuration.
+                while hits and (len(hits) * pg + self.engine.bucket_for(
+                        len(prompt) - len(hits) * pg)
+                        > self.engine.max_seq):
+                    hits.pop()
+                start = len(hits) * pg
+                n_prompt_pages = -(-len(prompt) // pg)
+                need = n_prompt_pages - len(hits)
+                # pinning an evictable (refcount-0) hit consumes one
+                # available page too — count both demands
+                evictable_hits = sum(
+                    1 for p in hits if self.pages.refcount(p) == 0)
+                if need + evictable_hits > self.pages.available:
+                    break
+                for p in hits:          # pin BEFORE alloc can evict them
+                    self.pages.acquire(p)
+                fresh = [self.pages.alloc() for _ in range(need)]
+                row = np.full(self.engine.n_ptab, GARBAGE_PAGE, np.int32)
+                row[:len(hits)] = hits
+                row[len(hits):n_prompt_pages] = fresh
+                suffix = prompt[start:]
+                # hashing is O(prompt) host work on the TTFT path —
+                # skip it entirely when the cache can never hit
+                hashes = (self.pages.page_hashes(prompt)
+                          if self.pages.prefix_cache else [])
+            self.queue.popleft()
             sp = req.sampling
             try:
                 self.arena, self.last_tokens, _ = self.engine.prefill(
-                    self.arena, self.last_tokens, slot, req.prompt, sp,
-                    self._next_key())
+                    self.arena, self.last_tokens, slot, suffix, sp,
+                    self._next_key(), page_row=row, start=start)
             except Exception as e:
                 # the arena was donated into the failing program: condemn
                 # the in-flight batch (and this request), keep the queue
@@ -411,6 +518,16 @@ class Scheduler:
                     req, f"engine failure: {self.last_engine_error}",
                     self.metrics.on_failure)
                 return
+            if self.pages is not None:
+                self._ptab[slot] = row
+                self._slot_pages[slot] = list(hits) + list(fresh)
+                # publish the freshly-computed FULL prompt pages under
+                # their chain hashes — the next identical prefix hits
+                # (deterministic model: same tokens at same positions
+                # => identical K/V, so first-writer-wins is sound)
+                for i in range(len(hits), len(hashes)):
+                    self.pages.register(hashes[i], int(row[i]))
+                self.metrics.on_prefix(len(hits), len(hashes), start)
             self.slots[slot] = req
             self._active[slot] = True
             self._state[slot] = _SlotState(req.rid, len(req.prompt),
@@ -424,8 +541,57 @@ class Scheduler:
             self._state[slot].dispatched(0)
             self._pending.append(
                 (self.last_tokens, None, ((slot, req.rid, 0),)))
-            self.metrics.on_admit(req, slot, len(req.prompt))
+            # prefill_tokens counts COMPUTED tokens: a prefix hit's
+            # skipped tokens land in prefill_tokens_saved instead
+            self.metrics.on_admit(req, slot, len(suffix))
             if req._guaranteed >= self._budget(req):
+                self._retire(slot)
+
+    # ---- paged growth -------------------------------------------------
+
+    def _grow_pages(self, lens):
+        """Map pages covering every active slot's worst-case write
+        window ``[0, pos_hi + draft_len + 1)`` before dispatch
+        (``lens`` is the per-slot draft length of the upcoming verify
+        step, or None for a plain decode step).  Growth is host
+        arithmetic over the same worst-case indices the overflow
+        settling already tracks — no device reads, no new programs (the
+        fresh table rides into the next dispatch as data).  A slot the
+        pool cannot grow for — free list dry AND nothing evictable — is
+        **shed** with the named :class:`PagePoolExhaustedError` message
+        (``req.error``, counted in ``requests_shed``) and its pages
+        free immediately, so the remaining traffic keeps stepping; the
+        capacity signal is the error string, not a stall."""
+        pg = self.engine.page_size
+        for slot, req in enumerate(self.slots):
+            if req is None or not self._active[slot]:
+                continue
+            st = self._state[slot]
+            width = 1 + (int(lens[slot]) if lens is not None else 0)
+            # pos_hi is a worst-case bound that runs one ahead of the
+            # true engine index (the admission pseudo-window settles
+            # into it), so near max_seq it can demand a page past the
+            # table.  Clamp to the table: the kernel clamps any
+            # actually-out-of-range write to position max_seq - 1,
+            # which is always in the slot's own LAST page — never a
+            # shared one, since prefix hits are capped at
+            # (prompt_len - 1) // page_size full pages — and such
+            # writes are post-budget garbage the harvest ignores
+            # (exactly the dense arena's clamped-write discipline).
+            need = min(-(-(st.pos_hi + width) // pg),
+                       self.engine.n_ptab)
+            pages = self._slot_pages[slot]
+            try:
+                while len(pages) < need:
+                    p = self.pages.alloc()
+                    self._ptab[slot, len(pages)] = p
+                    pages.append(p)
+            except PagePoolExhaustedError as e:
+                self._finish_error(
+                    req, f"{e} (shed after {len(req.tokens)} harvested "
+                         f"tokens)", self.metrics.on_shed)
+                self.observer.event("page_pool_shed", rid=req.rid,
+                                    slot=slot)
                 self._retire(slot)
 
     # ---- drafting -----------------------------------------------------
@@ -520,6 +686,9 @@ class Scheduler:
                 self._contain(e)
         self.step_count += 1
         self.metrics.on_step(n_active, self.engine.n_slots)
+        if self.pages is not None:
+            self.metrics.on_pages(self.pages.pages_in_use,
+                                  self.pages.capacity)
         if len(self._pending) > self.harvest_lag:
             with self.observer.span("harvest"):
                 while len(self._pending) > self.harvest_lag:
@@ -533,6 +702,12 @@ class Scheduler:
         with self.observer.span("draft", n_active=n_active):
             k_prog, drafts, lens = self._make_drafts()
         self.metrics.on_draft(time.perf_counter() - t_draft)
+        tables = None
+        if self.pages is not None:
+            self._grow_pages(lens if k_prog else None)
+            if not self._active.any():   # every slot shed this round
+                return
+            tables = self._ptab          # snapshot copied at dispatch
         if k_prog > 0:
             entries = tuple(
                 (slot, req.rid, int(lens[slot]))
@@ -544,7 +719,7 @@ class Scheduler:
                  counts) = self.engine.verify(
                     self.arena, self.last_tokens, drafts, lens,
                     self._active, self._next_key(), self._temp,
-                    self._topk, self._topp)
+                    self._topk, self._topp, page_tables=tables)
             self._pending.append((window, counts, entries))
             self.metrics.on_verify(k_prog)
             for slot, rid, dl in entries:
@@ -558,7 +733,7 @@ class Scheduler:
                 self.arena, self.last_tokens, _ = self.engine.decode(
                     self.arena, self.last_tokens, self._active,
                     self._next_key(), self._temp, self._topk,
-                    self._topp)
+                    self._topp, page_tables=tables)
             self._pending.append((self.last_tokens, None, entries))
             for slot, rid, _ in entries:
                 self._state[slot].dispatched(0)

@@ -50,6 +50,23 @@ def main():
     flag(parser, "--draft", default="ngram", choices=["ngram", "model"],
          help="draft source for --speculate: device-free n-gram prompt "
               "lookup, or a small draft transformer sharing the vocab")
+    flag(parser, "--page-size", type=int, default=0,
+         help="block-paged KV arena: tokens per page (0 = dense "
+              "per-slot rows; must divide max_seq)")
+    flag(parser, "--n-pages", type=int, default=0,
+         help="page-pool size for --page-size (0 = dense-equivalent "
+              "capacity; smaller overcommits HBM, admission then gates "
+              "on free pages)")
+    import argparse
+    flag(parser, "--prefix-cache", action=argparse.BooleanOptionalAction,
+         default=True,
+         help="cross-request prefix caching over full prompt pages "
+              "(paged arena only): identical prompt prefixes prefill "
+              "once and are shared read-only")
+    flag(parser, "--shared-prefix", type=int, default=0,
+         help="synthetic traffic: give every request this many common "
+              "leading tokens (a system prompt) so the prefix cache "
+              "has something to hit")
     flag(parser, "--seed", type=int, default=0)
     flag(parser, "--trace", default="",
          help="write a Chrome-trace-event JSON (Perfetto-loadable) of "
@@ -72,7 +89,8 @@ def main():
     from dtdl_tpu.obs import Observer
     obs = Observer(trace_path=args.trace or None, sentinel="warn")
     engine = InferenceEngine(model, params, n_slots=args.n_slots,
-                             observer=obs)
+                             observer=obs, page_size=args.page_size,
+                             n_pages=args.n_pages or None)
     draft = None
     if args.speculate and args.draft == "model":
         # demo draft transformer: a narrower random-init LM sharing the
@@ -82,18 +100,28 @@ def main():
                             attn_impl="dense", dtype=jnp.float32)
         dp = nn.unbox(dm.init(jax.random.PRNGKey(args.seed + 1),
                               example)["params"])
-        draft = ModelDraft(dm, dp)
+        # warmup pre-compiles the (ctx-bucket, k-bucket) generate
+        # family NOW so the first request doesn't eat the compile
+        draft = ModelDraft(dm, dp, warmup=args.speculate)
     sched = Scheduler(engine, seed=args.seed,
                       harvest_lag=args.harvest_lag, observer=obs,
-                      draft=draft)
+                      draft=draft, prefix_cache=args.prefix_cache)
     sp = SampleParams(temperature=args.temperature, top_k=args.top_k,
                       top_p=args.top_p)
 
-    # synthetic traffic: mixed prompt lengths, one shared sampling config
+    # synthetic traffic: mixed prompt lengths, one shared sampling
+    # config; --shared-prefix prepends a common "system prompt" so the
+    # paged arena's prefix cache has repeated leading pages to hit
     rng = np.random.default_rng(args.seed)
-    lens = rng.integers(4, min(64, model.max_seq // 2),
-                        args.n_requests)
-    reqs = [Request(rng.integers(0, model.vocab_size, n).tolist(),
+    hi = min(64, model.max_seq // 2)
+    if not 0 <= args.shared_prefix <= model.max_seq - hi - 1:
+        parser.error(f"--shared-prefix must be in [0, "
+                     f"{model.max_seq - hi - 1}] for this model")
+    common = rng.integers(0, model.vocab_size,
+                          args.shared_prefix).tolist()
+    lens = rng.integers(4, hi, args.n_requests)
+    reqs = [Request(common + rng.integers(0, model.vocab_size,
+                                          n).tolist(),
                     args.max_new_tokens, sampling=sp,
                     speculate=args.speculate) for n in lens]
 
@@ -113,6 +141,15 @@ def main():
               f"   per-token p50/p99: "
               f"{s.get('tok_latency_s_p50', 0.0) * 1e3:.2f} / "
               f"{s.get('tok_latency_s_p99', 0.0) * 1e3:.2f} ms")
+    if args.page_size:
+        # the paged-arena receipts: how much prefill the prefix cache
+        # skipped, and how many pool pages live traffic ever pinned
+        print(f"  paged kv (page_size={args.page_size}): prefix hit "
+              f"rate {s['prefix_hit_rate']:.0%}  prefill tokens saved "
+              f"{s['prefill_tokens_saved']}  pages in use "
+              f"{s['pages_in_use_last']}/{s['page_capacity']} "
+              f"(peak {s['pages_in_use_peak']})  shed "
+              f"{s['requests_shed']}")
     if args.speculate:
         # per-request ACCEPTED tokens/sec (delivered tokens over the
         # request's own decode window) — the user-visible spec win

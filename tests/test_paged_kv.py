@@ -1,0 +1,376 @@
+"""Paged KV arena + prefix cache: the ISSUE-6 contracts.
+
+Same tiny f32 dense config as tests/test_serve.py, ONE shared paged
+engine for the module (watched by a RecompileSentinel at policy='raise'
+from construction, so every test doubles as a zero-recompile pin):
+
+* **token identity** — paged decode/verify produce, per request,
+  exactly the tokens the dense path produces: mixed-length traffic with
+  mid-flight admission and slot reuse, speculative verify, and
+  prefix-cache-hit prefills (the suffix re-enters at ``start > 0`` and
+  attends shared pages);
+* **prefill skipped on prefix hits** — receipts, not vibes: the hit
+  admission's only prefill call is the SUFFIX bucket
+  (``engine.prefill_calls``), and ``prefill_tokens_saved`` counts the
+  skipped tokens exactly;
+* **divergence safety** — requests sharing prefix pages (refcount > 1)
+  decode independent continuations without corrupting each other;
+* **bounded exhaustion** — a pool too small for a growing sequence
+  sheds THAT request with the named PagePoolExhaustedError text while
+  queued traffic completes; a prompt that can never fit is rejected at
+  submit;
+* **eviction policy** — LRU over refcount-zero cached pages only;
+  pinned pages survive however cold.
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from dtdl_tpu.models.transformer import transformer_lm
+from dtdl_tpu.obs import Observer
+from dtdl_tpu.serve import (
+    InferenceEngine, ModelDraft, NGramDraft, PageAllocator,
+    PagePoolExhaustedError, Request, Scheduler,
+)
+
+MAX_SEQ = 48
+BUCKETS = (8, 16)
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    return transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, attn_impl="dense", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return nn.unbox(model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))["params"])
+
+
+@pytest.fixture(scope="module")
+def obs():
+    return Observer(sentinel="raise")
+
+
+@pytest.fixture(scope="module")
+def engine(model, params, obs):
+    # the sentinel is attached from construction: EVERY dispatch in this
+    # module raises on a genuine retrace, so page-table remaps, prefix
+    # hits, occupancy changes and pool reuse are all pinned as data-only
+    return InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                           page_size=PAGE, observer=obs)
+
+
+def ref_greedy(model, params, prompt, n_new):
+    """One-at-a-time eager reference (same oracle as tests/test_serve)."""
+    cache = model.init_cache(1)
+    _, m = model.apply({"params": params, "cache": cache},
+                       jnp.asarray([prompt], jnp.int32), decode=True,
+                       mutable=["cache"])
+    logits = model.apply({"params": params},
+                         jnp.asarray([prompt], jnp.int32))
+    out = [int(jnp.argmax(logits[0, -1]))]
+    cache = m["cache"]
+    for _ in range(n_new - 1):
+        logits, m = model.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray([[out[-1]]], jnp.int32), decode=True,
+            mutable=["cache"])
+        cache = m["cache"]
+        out.append(int(jnp.argmax(logits[0, -1])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host-side allocator policy (no jax)
+# ---------------------------------------------------------------------------
+
+def test_allocator_refcounts_and_free_list():
+    al = PageAllocator(n_pages=5, page_size=4)
+    assert al.capacity == 4 and al.available == 4
+    a, b = al.alloc(), al.alloc()
+    assert a != b and 0 not in (a, b)        # page 0 reserved
+    assert al.pages_in_use == 2
+    al.acquire(a)                            # shared: refcount 2
+    al.release(a)
+    assert al.refcount(a) == 1 and al.pages_in_use == 2
+    al.release(a)
+    al.release(b)
+    assert al.pages_in_use == 0 and al.available == 4
+    # a/b were never registered -> straight back to the free list
+    assert al.cached_pages() == 0
+
+
+def test_eviction_keeps_refcounted_pages_alive():
+    al = PageAllocator(n_pages=4, page_size=2)     # 3 usable pages
+    toks = list(range(8))
+    h = al.page_hashes(toks)                       # 4 chain hashes
+    p1, p2, p3 = al.alloc(), al.alloc(), al.alloc()
+    al.register(h[0], p1)
+    al.register(h[1], p2)
+    al.register(h[2], p3)
+    al.release(p1)                                 # evictable, LRU-first
+    al.release(p2)
+    # p3 stays pinned: the next two allocs must evict p1 then p2 (LRU
+    # order) and NEVER p3
+    q1, q2 = al.alloc(), al.alloc()
+    assert {q1, q2} == {p1, p2}
+    assert al.refcount(p3) == 1 and al.cached_pages() == 1
+    assert al.match_prefix(toks) == [], "evicted pages must unmap"
+    with pytest.raises(PagePoolExhaustedError, match="pinned"):
+        al.alloc()                                 # everything pinned
+    # releasing the pinned cached page makes it evictable again
+    al.release(p3)
+    assert al.alloc() == p3
+
+
+def test_chained_hashes_demand_whole_prefix_match():
+    al = PageAllocator(n_pages=8, page_size=4)
+    a = al.page_hashes([1, 2, 3, 4, 5, 6, 7, 8])
+    b = al.page_hashes([9, 2, 3, 4, 5, 6, 7, 8])   # page 0 differs
+    assert a[0] != b[0]
+    assert a[1] != b[1], "page 1 must rehash when page 0's tokens differ"
+    # cap: at least one prompt token always prefills
+    al.register(a[0], al.alloc())
+    al.register(a[1], al.alloc())
+    assert len(al.match_prefix([1, 2, 3, 4, 5, 6, 7, 8])) == 1
+    assert len(al.match_prefix([1, 2, 3, 4, 5, 6, 7, 8, 9])) == 2
+
+
+# ---------------------------------------------------------------------------
+# token identity + receipts on the shared paged engine
+# ---------------------------------------------------------------------------
+
+def test_paged_greedy_token_identical_mixed_traffic(model, params, engine):
+    """THE paged pin: mixed-length prompts through 2 slots with slot
+    reuse and mid-flight admission — page growth, retirement reuse and
+    table remaps included — each request's tokens == its solo eager
+    greedy decode; every page released at the end."""
+    gen = np.random.default_rng(1)
+    lens = (3, 9, 14, 5, 7)
+    n_new = (6, 4, 8, 3, 5)
+    prompts = [gen.integers(0, 64, n).tolist() for n in lens]
+    reqs = [Request(p, n) for p, n in zip(prompts, n_new)]
+    sched = Scheduler(engine, harvest_lag=3)
+    done = sched.run(reqs)
+    assert len(done) == len(reqs)
+    for req, prompt, n in zip(reqs, prompts, n_new):
+        assert req.done
+        assert req.tokens == ref_greedy(model, params, prompt, n), \
+            f"rid={req.rid} diverged from solo decode under paging"
+    s = sched.metrics.summary()
+    assert s["pages_in_use_peak"] > 0
+    assert sched.pages.pages_in_use == 0, "retirement must release pages"
+
+
+def test_prefix_hit_skips_prefill_with_receipts(model, params, engine):
+    """Cross-request prefix caching: the second identical prompt maps
+    its full leading page read-only and prefills ONLY the suffix —
+    verified by the engine's per-bucket prefill-call counters (FLOPs ∝
+    bucket · calls) and the exact prefill_tokens_saved count — with
+    token-identical output."""
+    gen = np.random.default_rng(2)
+    prompt = gen.integers(0, 64, 16).tolist()   # 2 full pages, cap -> 1
+    ref = ref_greedy(model, params, prompt, 5)
+    sched = Scheduler(engine, harvest_lag=2)
+    r1 = Request(prompt, 5)
+    sched.run([r1])
+    assert r1.tokens == ref
+    before = dict(engine.prefill_calls)
+    r2 = Request(prompt, 5)
+    sched.run([r2])
+    assert r2.tokens == ref
+    delta = {T: n - before.get(T, 0)
+             for T, n in engine.prefill_calls.items()
+             if n - before.get(T, 0)}
+    # ONE prefill, through the 8-token SUFFIX bucket — not the 16 bucket
+    # the cold admission used
+    assert delta == {8: 1}, delta
+    s = sched.metrics.summary()
+    assert s["prefill_tokens_saved"] == PAGE
+    assert s["prefix_hit_rate"] > 0
+    # the same engine serves a prefix-cache-off scheduler identically
+    cold = Scheduler(engine, harvest_lag=2, prefix_cache=False)
+    r3 = Request(prompt, 5)
+    cold.run([r3])
+    assert r3.tokens == ref
+    assert cold.metrics.summary()["prefill_tokens_saved"] == 0
+
+
+def test_shared_prefix_divergence_is_isolated(model, params, engine):
+    """Copy-on-write contract: two live requests share read-only prefix
+    pages (refcount 2) while decoding DIVERGENT continuations — the
+    write frontier always lands on private pages, so neither corrupts
+    the other and both match their solo decodes."""
+    gen = np.random.default_rng(3)
+    base = gen.integers(0, 64, PAGE).tolist()     # one shareable page
+    pa = base + gen.integers(0, 64, 5).tolist()
+    pb = base + gen.integers(0, 64, 5).tolist()
+    ra, rb = ref_greedy(model, params, pa, 6), ref_greedy(model, params,
+                                                          pb, 6)
+    assert ra != rb, "degenerate rng draw: continuations must diverge"
+    sched = Scheduler(engine, harvest_lag=2)
+    sched.run([Request(pa, 1)])                   # warm the cache
+    shared = sched.pages.match_prefix(pa)
+    assert len(shared) == 1
+    qa, qb = Request(pa, 6), Request(pb, 6)
+    sched.submit(qa)
+    sched.submit(qb)
+    peak_ref = 0
+    # run()'s own loop condition: done flips only at harvest, and
+    # step() deliberately leaves harvest_lag windows in flight
+    while sched.queue or any(s is not None for s in sched.slots):
+        sched.step()
+        peak_ref = max(peak_ref, sched.pages.refcount(shared[0]))
+    sched.drain()
+    assert qa.done and qb.done
+    assert peak_ref == 2, "both requests must map the SAME page"
+    assert qa.tokens == ra and qb.tokens == rb
+    assert sched.metrics.prefix_hit_pages >= 2
+
+
+def test_page_pool_exhaustion_sheds_named_and_run_continues(model,
+                                                            params):
+    """An undersized pool: the growing request is shed with the named
+    PagePoolExhaustedError text (its pages freed), and a queued request
+    then completes against the same pool."""
+    eng = InferenceEngine(model, params, n_slots=1, buckets=(8,),
+                          page_size=PAGE, n_pages=3)
+    gen = np.random.default_rng(4)
+    grower = Request(gen.integers(0, 64, 8).tolist(), 20)
+    queued = Request(gen.integers(0, 64, 6).tolist(), 3)
+    sched = Scheduler(eng, harvest_lag=1)
+    sched.run([grower, queued])
+    assert grower.error is not None and \
+        "page pool exhausted" in grower.error, grower.error
+    assert queued.done and queued.error is None
+    assert len(queued.tokens) == 3
+    s = sched.metrics.summary()
+    assert s["requests_shed"] == 1 and s["requests_finished"] == 1
+    assert sched.pages.pages_in_use == 0
+    # a prompt that could NEVER fit the pool is rejected at submit with
+    # the same named reason (no admission livelock)
+    tiny = InferenceEngine(model, params, n_slots=1, buckets=(8,),
+                           page_size=PAGE, n_pages=2)
+    bad = Scheduler(tiny).submit(Request(gen.integers(0, 64, 8).tolist(),
+                                         2))
+    assert bad.done and bad.error and "page pool" in bad.error
+
+
+def test_paged_compile_receipts_zero_recompiles(engine, obs):
+    """The program-count contract, cumulatively over every test above:
+    still ONE decode program and one prefill per touched bucket — page
+    tables, occupancy, prefix hits and pool reuse are data — and the
+    policy='raise' sentinel saw zero genuine retraces."""
+    stats = engine.compile_stats()
+    assert stats["decode"] == 1, stats
+    assert stats["prefill"] and \
+        all(n == 1 for n in stats["prefill"].values()), stats
+    assert stats["paged"] == {"page_size": PAGE,
+                              "n_pages": 2 * (MAX_SEQ // PAGE) + 1,
+                              "pages_per_slot": MAX_SEQ // PAGE}
+    assert obs.sentinel.summary()["recompile_events"] == 0
+
+
+def test_paged_spec_decode_token_identical(model, params, engine):
+    """Speculative verify over the paged arena: mixed spec/non-spec
+    greedy traffic with n-gram drafts matches the solo decodes exactly
+    (the verify program family rides the same page tables)."""
+    gen = np.random.default_rng(5)
+    lens = (5, 9, 12)
+    n_new = (10, 9, 8)
+    prompts = [gen.integers(0, 64, n).tolist() for n in lens]
+    refs = [ref_greedy(model, params, p, n)
+            for p, n in zip(prompts, n_new)]
+    reqs = [Request(p, n, speculate=(4 if i % 2 == 0 else 0))
+            for i, (p, n) in enumerate(zip(prompts, n_new))]
+    sched = Scheduler(engine, harvest_lag=2, draft=NGramDraft())
+    sched.run(reqs)
+    for req, want in zip(reqs, refs):
+        assert req.done and req.tokens == want, \
+            f"rid={req.rid} diverged under paged speculation"
+    s = sched.metrics.summary()
+    assert s["spec_steps"] > 0
+    assert sched.pages.pages_in_use == 0
+
+
+def test_spec_budget_clamp_near_max_seq_paged(model, params, engine):
+    """Speculative overshoot near max_seq on pages: worst-case settling
+    plus page growth keep verify writes mapped, and the clamped budget
+    emits exactly the dense count."""
+    gen = np.random.default_rng(6)
+    prompt = gen.integers(0, 64, 14).tolist()
+    ref = ref_greedy(model, params, prompt, MAX_SEQ - 14 + 1)
+    req = Request(prompt, 99, speculate=4)
+    Scheduler(engine, harvest_lag=2, draft=NGramDraft()).run([req])
+    assert req.done
+    assert len(req.tokens) == MAX_SEQ - len(prompt) + 1
+    assert req.tokens == ref
+
+
+def test_prefix_hits_capped_when_suffix_bucket_overshoots(model, params):
+    """A coarse bucket grid + tiny pages can leave a cache-hit suffix
+    whose PADDED bucket extends past max_seq — the kernel would clamp
+    the write window backward over the cached pages themselves.  The
+    scheduler must drop trailing hits until the padded window fits
+    (token-identical output, partial hit still counted), and the
+    engine must refuse a caller-supplied overshooting start."""
+    m32 = transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=32, attn_impl="dense", dtype=jnp.float32)
+    p32 = nn.unbox(m32.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 4), jnp.int32))["params"])
+    eng = InferenceEngine(m32, p32, n_slots=1, buckets=(8, 32),
+                          page_size=4)
+    # the engine-level guard: start 28 + bucket_for(2)=8 > 32
+    with pytest.raises(ValueError, match="padded bucket"):
+        eng.prefill(eng.init_arena(), eng.init_last_tokens(), 0,
+                    [1, 2], page_row=np.zeros(8, np.int32), start=28)
+    # the scheduler-level cap: prompt 30 caches 7 full pages; naive
+    # hits=7 -> start 28, suffix bucket 8 -> 36 > 32.  Must cap at 6
+    # hits (24 + 8 = 32) and stay token-identical.
+    gen = np.random.default_rng(8)
+    prompt = gen.integers(0, 64, 30).tolist()
+    ref = ref_greedy(m32, p32, prompt, 2)
+    sched = Scheduler(eng, harvest_lag=1)
+    r1 = Request(prompt, 2)
+    sched.run([r1])
+    assert r1.tokens == ref
+    before = dict(eng.prefill_calls)
+    r2 = Request(prompt, 2)
+    sched.run([r2])
+    assert r2.tokens == ref and r2.error is None
+    delta = {T: n - before.get(T, 0)
+             for T, n in eng.prefill_calls.items()
+             if n - before.get(T, 0)}
+    assert delta == {8: 1}, delta          # capped hit, suffix bucket
+    assert sched.metrics.summary()["prefill_tokens_saved"] == 24
+
+
+@pytest.mark.slow   # compiles the (ctx-bucket, k-bucket) generate family
+def test_model_draft_warmup_precompiles_and_is_stable(model, params):
+    """The PR 4 known-remaining fix: warmup=k pre-compiles the draft
+    family at construction, and k-bucketing (generate the power-of-two
+    bucket, return the asked-for prefix — greedy is prefix-stable)
+    keeps proposals identical to the lazy path."""
+    from dtdl_tpu.models.transformer import _compiled_generate
+    lazy = ModelDraft(model, params, window=4)
+    gen = np.random.default_rng(7)
+    ctx = gen.integers(0, 64, 9)
+    want = {k: lazy.propose(ctx, k).tolist() for k in (1, 2, 3)}
+    before = _compiled_generate.cache_info().currsize
+    warm = ModelDraft(model, params, window=4, warmup=2)
+    after = _compiled_generate.cache_info().currsize
+    assert after >= before  # family resident (shared lru with lazy runs)
+    for k in (1, 2, 3):
+        assert warm.propose(ctx, k).tolist() == want[k]
+        assert len(want[k]) == k
+    # proposing inside the warmed family compiles nothing new
+    assert _compiled_generate.cache_info().currsize == after
