@@ -476,6 +476,7 @@ def bench_serving(size: str = None, slot_sweep=(1, 4, 8),
         })
     row["spec"] = bench_spec_decode(model, params)
     row["paged"] = bench_paged()
+    row["quant"] = bench_quant(model, params)
     return row
 
 
@@ -626,6 +627,70 @@ def bench_paged(size: str = "small", n_slots: int = 4,
             "prefill_tokens_saved": s["prefill_tokens_saved"],
             "pages_in_use_peak": s["pages_in_use_peak"],
         })
+    return out
+
+
+def bench_quant(model, params, n_slots: int = 4, page_size: int = 32,
+                new_tokens: int = 48) -> list:
+    """Quantized-serving sweep: f32 / w8 / w8+kv8 × dense/paged
+    (ISSUE 7 acceptance).
+
+    Six engines over the same tiny model and traffic, scheduler-driven
+    like the spec/paged rows (warmup run compiles, second run is timed).
+    Decode is HBM-bandwidth-bound, so on TPU tokens/sec tracks the
+    ``bytes_per_token`` receipt each row carries from
+    ``compile_stats()['quant']`` — ``(param_bytes + kv_arena_bytes) /
+    n_slots``, the roofline numerator.  On this CPU box the timing is
+    honest but NOT the roofline: XLA:CPU pays the int8→f32 convert as
+    real compute instead of hiding it under an HBM read, so the w8 rows
+    can be slower than f32 here while the byte receipts — the thing
+    that transfers to TPU — shrink ~4x (f32 weights) and >2x (KV arena;
+    SCALING.md "Quantized serving arithmetic").  The paged rows all get
+    the SAME ``kv_pool_bytes`` budget (the f32 dense-equivalent pool),
+    so the int8 row's ``n_pages`` IS the capacity-multiplier receipt:
+    slots-per-HBM-byte, measured in pages, at fixed bytes.
+    """
+    from dtdl_tpu.serve import InferenceEngine, Request, Scheduler
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size, int(n)).tolist()
+               for n in rng.integers(8, 16, n_slots)]
+    new_tokens = min(new_tokens, model.max_seq - 16)
+    # one fixed HBM budget for every paged row: what the f32 pool needs
+    # at dense-equivalent capacity
+    probe = InferenceEngine(model, params, n_slots=n_slots,
+                            page_size=page_size)
+    pool_budget = probe.page_bytes * probe.n_pages
+    out = []
+    for arena in ("dense", "paged"):
+        for label, w8, kv in (("f32", False, None),
+                              ("w8", True, None),
+                              ("w8kv8", True, "int8")):
+            kw = (dict(page_size=page_size, kv_pool_bytes=pool_budget)
+                  if arena == "paged" else {})
+            engine = InferenceEngine(model, params, n_slots=n_slots,
+                                     quantize_weights=w8, kv_dtype=kv,
+                                     **kw)
+
+            def run():
+                reqs = [Request(p, new_tokens) for p in prompts]
+                sched = Scheduler(engine, harvest_lag=1)
+                sched.run(reqs)
+                return sched.metrics.summary()
+
+            run()                  # warmup: compile prefill + decode
+            s = run()              # timed
+            q = engine.compile_stats()["quant"]
+            out.append({
+                "arena": arena, "weights": label,
+                "kv_dtype": q["kv_dtype"] or "f32",
+                "decode_tokens_per_sec": s["decode_tokens_per_sec"],
+                "ttft_s_mean": s["ttft_s_mean"],
+                "param_bytes": q["param_bytes"],
+                "kv_arena_bytes": q["kv_arena_bytes"],
+                "bytes_per_token": q["decode_hbm_bytes_per_token"],
+                "n_pages": engine.n_pages,
+            })
     return out
 
 
@@ -1123,6 +1188,34 @@ def main(argv=None) -> dict:
             summary["serve_prefix_ttft_vs_dense"] = round(
                 pp["ttft_s_mean"] / dense["ttft_s_mean"], 3) \
                 if dense["ttft_s_mean"] else None
+    if serve_row and serve_row.get("quant"):
+        # quantization receipt (ISSUE 7): measured tokens/sec per config
+        # plus the byte receipts that ARE the TPU speedup (decode is
+        # HBM-BW-bound; CPU timings here pay the dequant as compute)
+        rows = {(e["arena"], e["weights"]): e
+                for e in serve_row["quant"]}
+        f32d, w8kv8d = rows.get(("dense", "f32")), \
+            rows.get(("dense", "w8kv8"))
+        if f32d and w8kv8d:
+            summary["serve_quant_tokens_per_sec"] = \
+                w8kv8d["decode_tokens_per_sec"]
+            summary["serve_quant_speedup_vs_f32"] = round(
+                w8kv8d["decode_tokens_per_sec"]
+                / f32d["decode_tokens_per_sec"], 3) \
+                if f32d["decode_tokens_per_sec"] else None
+            summary["serve_quant_bytes_per_token"] = \
+                w8kv8d["bytes_per_token"]
+            summary["serve_quant_bytes_per_token_f32"] = \
+                f32d["bytes_per_token"]
+            summary["serve_quant_param_bytes_ratio"] = round(
+                f32d["param_bytes"] / w8kv8d["param_bytes"], 3)
+            summary["serve_quant_kv_arena_ratio"] = round(
+                f32d["kv_arena_bytes"] / w8kv8d["kv_arena_bytes"], 3)
+        f32p, w8kv8p = rows.get(("paged", "f32")), \
+            rows.get(("paged", "w8kv8"))
+        if f32p and w8kv8p and f32p["n_pages"]:
+            summary["serve_quant_paged_capacity_x"] = round(
+                w8kv8p["n_pages"] / f32p["n_pages"], 3)
 
     full = dict(summary)
     full["records"] = records

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from dtdl_tpu.ops.attention import flash_attention, mha_reference
 from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
+from dtdl_tpu.quant import QuantDenseGeneral, canon_kv_dtype, kv_quantize
 
 Dtype = Any
 
@@ -69,16 +70,17 @@ def _part(init, *names):
 
 
 def _required_cache_leaf(name):
-    """Init fn for cache leaves the caller must supply (the paged-arena
-    layout is built by the serving engine, never by an init trace): if
-    flax falls back to initializing one, the cache pytree was malformed
-    — fail with the diagnosis instead of allocating a silent zero."""
+    """Init fn for cache leaves the caller must supply (the paged and
+    int8 arena layouts are built by the serving engine's init helpers,
+    never by an init trace): if flax falls back to initializing one, the
+    cache pytree was malformed — fail with the diagnosis instead of
+    allocating a silent zero."""
     def init(*_):
         raise ValueError(
-            f"paged KV cache is missing the '{name}' leaf; build the "
-            f"arena with TransformerLM.init_paged_cache and let the "
-            f"serving engine insert the per-call page_table/active "
-            f"leaves (dtdl_tpu/serve/engine.py)")
+            f"KV cache is missing the '{name}' leaf; build the arena "
+            f"with TransformerLM.init_cache/init_paged_cache (the "
+            f"serving engine inserts any per-call page_table/active "
+            f"leaves itself — dtdl_tpu/serve/engine.py)")
     return init
 
 
@@ -101,11 +103,18 @@ class Attention(nn.Module):
     head_dim: int
     attn_impl: str = "flash"      # 'flash' | 'dense'
     dtype: Dtype = jnp.bfloat16
+    quantize: bool = False        # int8 weight-only projections (serve)
 
     @nn.compact
     def __call__(self, x, cos, sin, decode: bool = False):
         d_model = x.shape[-1]
         def proj(name):
+            if self.quantize:
+                # same module path + 'kernel' param name as the f32
+                # layer, so quantize_params maps tree-to-tree
+                return QuantDenseGeneral(
+                    features=(self.n_heads, self.head_dim), axis=-1,
+                    dtype=self.dtype, name=name)
             return nn.DenseGeneral(
                 features=(self.n_heads, self.head_dim), axis=-1,
                 use_bias=False, dtype=self.dtype,
@@ -127,6 +136,10 @@ class Attention(nn.Module):
             else:
                 o = mha_reference(q, k, v, causal=True).astype(self.dtype)
         o = o.transpose(0, 2, 1, 3)
+        if self.quantize:
+            return QuantDenseGeneral(
+                features=d_model, axis=(-2, -1), dtype=self.dtype,
+                name="out")(o)
         return nn.DenseGeneral(
             features=d_model, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             kernel_init=_part(nn.initializers.lecun_normal(),
@@ -181,10 +194,23 @@ class Attention(nn.Module):
         # example input into the returned cache and leave index=1 — every
         # later position would be off by one
         cache_exists = self.has_variable("cache", "key")
+        # int8 KV layout (init_cache(kv_dtype='int8')): the cache pytree
+        # itself carries the layout — scale leaves present means the K/V
+        # buffers are int8 and every write quantizes / every read
+        # dequants in-kernel.  Data-driven like the paged routing above,
+        # so the SAME module serves both layouts (one compiled program
+        # per engine either way; the engine never mixes layouts).
+        quant = self.has_variable("cache", "key_scale")
         ck = self.variable("cache", "key", jnp.zeros,
                            (b, h, max_len, d), self.dtype)
         cv = self.variable("cache", "value", jnp.zeros,
                            (b, h, max_len, d), self.dtype)
+        cks = cvs = None
+        if quant:
+            cks = self.variable("cache", "key_scale",
+                                _required_cache_leaf("key_scale"))
+            cvs = self.variable("cache", "value_scale",
+                                _required_cache_leaf("value_scale"))
         ci = self.variable("cache", "index",
                            lambda: jnp.zeros((), jnp.int32))
         if not cache_exists:
@@ -204,13 +230,27 @@ class Attention(nn.Module):
                     f"index would clamp and corrupt the last row")
         if pos.ndim:
             return self._verify_attend_slots(q, k, v, cos, sin,
-                                             ck, cv, ci, pos)
+                                             ck, cv, ci, pos, cks, cvs)
         q = apply_rope(q, cos, sin, offset=pos)
         k = apply_rope(k, cos, sin, offset=pos)
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(self.dtype), (0, 0, pos, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(self.dtype), (0, 0, pos, 0))
+        if quant:
+            # quantize-on-scatter: each new position's K/V row is scaled
+            # off its own max (write-once — see quant.kv_quantize)
+            k8, ks = kv_quantize(k)
+            v8, vs = kv_quantize(v)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k8, (0, 0, pos, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v8, (0, 0, pos, 0))
+            cks.value = jax.lax.dynamic_update_slice(
+                cks.value, ks, (0, 0, pos))
+            cvs.value = jax.lax.dynamic_update_slice(
+                cvs.value, vs, (0, 0, pos))
+        else:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, 0, pos, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, 0, pos, 0))
         ci.value = pos + s_new
 
         keys, values = ck.value, cv.value
@@ -219,10 +259,26 @@ class Attention(nn.Module):
         def attend(q_rows, qpos):
             """[B, H, C, D] query rows at global positions qpos [C]."""
             mask = jnp.arange(max_len)[None, :] <= qpos[:, None]
-            logits = jnp.einsum("bhqd,bhkd->bhqk", q_rows, keys,
-                                preferred_element_type=jnp.float32)
+            if quant:
+                # dequant-on-gather, fused: the int8→dtype convert rides
+                # the einsum's operand read, the per-position key scale
+                # multiplies the [.., K] logits (constant along the
+                # contracted D, so this IS the dequantized matmul), and
+                # the value scale folds into the softmax weights — no
+                # dequantized [.., D] copy is ever materialized
+                logits = jnp.einsum("bhqd,bhkd->bhqk", q_rows,
+                                    keys.astype(self.dtype),
+                                    preferred_element_type=jnp.float32)
+                logits = logits * cks.value[:, :, None, :]
+            else:
+                logits = jnp.einsum("bhqd,bhkd->bhqk", q_rows, keys,
+                                    preferred_element_type=jnp.float32)
             logits = jnp.where(mask[None, None], logits * scale, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
+            if quant:
+                w = (probs * cvs.value[:, :, None, :]).astype(self.dtype)
+                return jnp.einsum("bhqk,bhkd->bhqd", w,
+                                  values.astype(self.dtype))
             return jnp.einsum("bhqk,bhkd->bhqd",
                               probs.astype(self.dtype), values)
 
@@ -244,7 +300,8 @@ class Attention(nn.Module):
         out = jnp.moveaxis(out, 0, 2).reshape(b, h, s_new + pad, d)
         return out[:, :, :s_new]
 
-    def _verify_attend_slots(self, q, k, v, cos, sin, ck, cv, ci, pos):
+    def _verify_attend_slots(self, q, k, v, cos, sin, ck, cv, ci, pos,
+                             cks=None, cvs=None):
         """Vector-index cached attention, ``s_new`` tokens per slot: row b
         is an independent slot whose new tokens sit at global positions
         ``pos[b] .. pos[b]+s_new-1``.  Same math as the scalar path per
@@ -274,6 +331,7 @@ class Attention(nn.Module):
         import math
         b, h, s_new, d = q.shape
         max_len = cos.shape[0]
+        quant = cks is not None
         rope_row = jax.vmap(
             lambda xb, p: apply_rope(xb[None], cos, sin, offset=p)[0])
         q = rope_row(q, pos)
@@ -281,18 +339,45 @@ class Attention(nn.Module):
         scatter_row = jax.vmap(
             lambda buf, new, p: jax.lax.dynamic_update_slice(
                 buf, new, (0, p, 0)))
-        ck.value = scatter_row(ck.value, k.astype(self.dtype), pos)
-        cv.value = scatter_row(cv.value, v.astype(self.dtype), pos)
+        if quant:
+            # quantize-on-scatter, per (row, head, position) — the same
+            # write-once discipline as the scalar path (quant.kv_quantize)
+            k8, ks = kv_quantize(k)
+            v8, vs = kv_quantize(v)
+            ck.value = scatter_row(ck.value, k8, pos)
+            cv.value = scatter_row(cv.value, v8, pos)
+            scatter_s = jax.vmap(
+                lambda buf, new, p: jax.lax.dynamic_update_slice(
+                    buf, new, (0, p)))
+            cks.value = scatter_s(cks.value, ks, pos)
+            cvs.value = scatter_s(cvs.value, vs, pos)
+        else:
+            ck.value = scatter_row(ck.value, k.astype(self.dtype), pos)
+            cv.value = scatter_row(cv.value, v.astype(self.dtype), pos)
         ci.value = pos + s_new
 
         scale = 1.0 / math.sqrt(d)
         qpos = pos[:, None] + jnp.arange(s_new)[None, :]        # [B, S]
         mask = (jnp.arange(max_len)[None, None, :]
                 <= qpos[:, :, None])                            # [B, S, max]
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
-                            preferred_element_type=jnp.float32)
+        if quant:
+            # dequant-on-gather, fused exactly like the scalar path: the
+            # int8→dtype convert rides the einsum operand read, the key
+            # scale multiplies the [.., K] logits, the value scale folds
+            # into the softmax weights
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q,
+                                ck.value.astype(self.dtype),
+                                preferred_element_type=jnp.float32)
+            logits = logits * cks.value[:, :, None, :]
+        else:
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
+                                preferred_element_type=jnp.float32)
         logits = jnp.where(mask[:, None], logits * scale, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
+        if quant:
+            w = (probs * cvs.value[:, :, None, :]).astype(self.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", w,
+                              cv.value.astype(self.dtype))
         return jnp.einsum("bhqk,bhkd->bhqd",
                           probs.astype(self.dtype), cv.value)
 
@@ -349,6 +434,16 @@ class Attention(nn.Module):
                             _required_cache_leaf("active"))
         ci = self.variable("cache", "index",
                            _required_cache_leaf("index"))
+        # int8 pools (init_paged_cache(kv_dtype='int8')): per-(page,
+        # head, in-page position) scales ride WITH their page through
+        # the same table — layout is data, same compiled program shape
+        quant = self.has_variable("cache", "pages_key_scale")
+        pks = pvs = None
+        if quant:
+            pks = self.variable("cache", "pages_key_scale",
+                                _required_cache_leaf("pages_key_scale"))
+            pvs = self.variable("cache", "pages_value_scale",
+                                _required_cache_leaf("pages_value_scale"))
         pos, table, active = ci.value, pt.value, act.value
         n_pages, H, page, D = pk.value.shape
         n_ptab = table.shape[1]
@@ -382,6 +477,20 @@ class Attention(nn.Module):
             upd = new.transpose(0, 2, 1, 3).reshape(b * s_new, H, D)
             fp = fp.at[flat.reshape(-1)].set(upd.astype(pool.dtype))
             return fp.reshape(n_pages, page, H, D).transpose(0, 2, 1, 3)
+        if quant:
+            # quantize-on-scatter through the SAME flat page offsets:
+            # each new position's K/V row is scaled off its own max, so
+            # append-only shared pages never need rescaling
+            k, ks = kv_quantize(k)
+            v, vs = kv_quantize(v)
+
+            def scatter_s(pool, new):    # pool [P,H,page], new [B,H,S]
+                fp = pool.transpose(0, 2, 1).reshape(n_pages * page, H)
+                upd = new.transpose(0, 2, 1).reshape(b * s_new, H)
+                fp = fp.at[flat.reshape(-1)].set(upd)
+                return fp.reshape(n_pages, page, H).transpose(0, 2, 1)
+            pks.value = scatter_s(pks.value, ks)
+            pvs.value = scatter_s(pvs.value, vs)
         pk.value = scatter(pk.value, k)
         pv.value = scatter(pv.value, v)
         ci.value = pos + s_new   # engine masks/rolls back, as dense
@@ -400,10 +509,31 @@ class Attention(nn.Module):
         qpos = pos_safe[:, None] + jnp.arange(s_new)[None, :]    # [B, S]
         mask = (jnp.arange(n_ptab * page)[None, None, :]
                 <= qpos[:, :, None])                     # [B, S, n_ptab*pg]
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
-                            preferred_element_type=jnp.float32)
+        if quant:
+            # dequant-on-gather, fused as in the dense paths: int8
+            # pages convert inside the einsum read, the key scale (the
+            # same gathered logical view as the pages) multiplies the
+            # [.., K] logits, the value scale folds into the softmax
+            # weights — garbage-page positions carry scale 0 or stale
+            # finite values, masked exactly like their K/V
+            def view_s(pool, row):
+                pages = jnp.take(pool, row, axis=0)   # [n_ptab, H, page]
+                return pages.transpose(1, 0, 2).reshape(H, n_ptab * page)
+            kss = jax.vmap(view_s, in_axes=(None, 0))(pks.value, table)
+            vss = jax.vmap(view_s, in_axes=(None, 0))(pvs.value, table)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q,
+                                keys.astype(self.dtype),
+                                preferred_element_type=jnp.float32)
+            logits = logits * kss[:, :, None, :]
+        else:
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                                preferred_element_type=jnp.float32)
         logits = jnp.where(mask[:, None], logits * scale, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
+        if quant:
+            w = (probs * vss[:, :, None, :]).astype(self.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", w,
+                              values.astype(self.dtype))
         return jnp.einsum("bhqk,bhkd->bhqd",
                           probs.astype(self.dtype), values)
 
@@ -411,20 +541,32 @@ class Attention(nn.Module):
 class SwiGLU(nn.Module):
     d_ff: int
     dtype: Dtype = jnp.bfloat16
+    quantize: bool = False        # int8 weight-only wi/wg/wo (serve)
 
     @nn.compact
     def __call__(self, x):
         d_model = x.shape[-1]
-        wi = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                      kernel_init=_part(nn.initializers.lecun_normal(),
-                                        "embed", "mlp"), name="wi")(x)
-        wg = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                      kernel_init=_part(nn.initializers.lecun_normal(),
-                                        "embed", "mlp"), name="wg")(x)
+        if self.quantize:
+            # same module paths + 'kernel' param names as the f32
+            # layers, so quantize_params maps tree-to-tree
+            def dense(features, name):
+                return QuantDenseGeneral(features=features, axis=-1,
+                                         dtype=self.dtype, name=name)
+        else:
+            def dense(features, name):
+                # wo is the row-parallel projection whatever the
+                # geometry — key the partition names off the param,
+                # not the feature count (d_ff == d_model would flip it)
+                names = (("mlp", "embed") if name == "wo"
+                         else ("embed", "mlp"))
+                return nn.Dense(
+                    features, use_bias=False, dtype=self.dtype,
+                    kernel_init=_part(nn.initializers.lecun_normal(),
+                                      *names), name=name)
+        wi = dense(self.d_ff, "wi")(x)
+        wg = dense(self.d_ff, "wg")(x)
         h = nn.silu(wg) * wi
-        return nn.Dense(d_model, use_bias=False, dtype=self.dtype,
-                        kernel_init=_part(nn.initializers.lecun_normal(),
-                                          "mlp", "embed"), name="wo")(h)
+        return dense(d_model, "wo")(h)
 
 
 class MoE(nn.Module):
@@ -473,6 +615,10 @@ class MoE(nn.Module):
     # excluded from routing (they take no capacity).  0 = the measured
     # default cap of 1024
     group_size: int = 0
+    # int8 weight-only expert wi/wg/wo (serve): per-(expert, output
+    # channel) scales; the router stays f32 (O(d) bytes, high
+    # sensitivity — dtdl_tpu/quant/core.py)
+    quantize: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -503,18 +649,31 @@ class MoE(nn.Module):
                                           * probs.mean(axis=(0, 1))))
 
         def expert_param(name, shape, in_ax, out_ax):
+            if self.quantize:
+                # int8 kernel + per-(expert, output-channel) scale, with
+                # the same param name (+ '_scale' sibling) so
+                # quantize_params maps tree-to-tree; placeholder values
+                # — a quantized model is served, never trained
+                q = self.param(name,
+                               lambda *_: jnp.zeros(shape, jnp.int8))
+                s = self.param(
+                    f"{name}_scale",
+                    lambda *_: jnp.ones((shape[0], 1, shape[2]),
+                                        jnp.float32))
+                return q.astype(self.dtype), s
             # batch_axis keeps the expert dim out of fan_in so every expert
             # initializes like its dense counterpart
             init = nn.initializers.lecun_normal(batch_axis=(0,))
             return self.param(
-                name, _part(init, *(("expert",) + (in_ax, out_ax))), shape)
+                name, _part(init, *(("expert",) + (in_ax, out_ax))),
+                shape).astype(self.dtype), None
 
         w_in = expert_param("wi", (self.n_experts, d_model, self.d_ff),
-                            "embed", "mlp").astype(self.dtype)
+                            "embed", "mlp")
         w_gate = expert_param("wg", (self.n_experts, d_model, self.d_ff),
-                              "embed", "mlp").astype(self.dtype)
+                              "embed", "mlp")
         w_out = expert_param("wo", (self.n_experts, self.d_ff, d_model),
-                             "mlp", "embed").astype(self.dtype)
+                             "mlp", "embed")
 
         if self.dispatch == "routed":
             return self._routed(x, probs, w_in, w_gate, w_out)
@@ -524,10 +683,29 @@ class MoE(nn.Module):
         gate = jnp.sum(probs * onehot1, axis=-1, keepdims=True)
         # dense dispatch: xe[e, b, s, d] = onehot[b, s, e] * x[b, s, d]
         xe = jnp.einsum("bse,bsd->ebsd", onehot1.astype(self.dtype), x)
-        h = nn.silu(jnp.einsum("ebsd,edf->ebsf", xe, w_gate)) * \
-            jnp.einsum("ebsd,edf->ebsf", xe, w_in)
-        y = jnp.einsum("ebsf,efd->bsd", h, w_out)
+        h = nn.silu(self._emm("ebsd,edf->ebsf", xe, w_gate)) * \
+            self._emm("ebsd,edf->ebsf", xe, w_in)
+        # quantized wo keeps the expert axis through the matmul (each
+        # expert has its own output scale, which cannot factor out of a
+        # cross-expert contraction) and sums after dequant; unquantized
+        # stays the original single contraction bit-for-bit
+        y = (jnp.sum(self._emm("ebsf,efd->ebsd", h, w_out), axis=0)
+             if self.quantize else
+             jnp.einsum("ebsf,efd->bsd", h, w_out[0]))
         return y * gate.astype(self.dtype)
+
+    def _emm(self, spec, x, w):
+        """Expert matmul over a ``(kernel, scale-or-None)`` pair: the
+        per-(expert, out-channel) scale is constant along the contracted
+        dims, so multiplying the e-leading rank-4 OUTPUT is exactly the
+        dequantized matmul (same identity as
+        dtdl_tpu/quant/layers.py:QuantDenseGeneral)."""
+        kernel, scale = w
+        y = jnp.einsum(spec, x, kernel)
+        if scale is not None:
+            y = (y * scale.reshape(scale.shape[0], 1, 1, -1)
+                 ).astype(self.dtype)
+        return y
 
     def _routed(self, x, probs, w_in, w_gate, w_out):
         """Capacity-factor top-k dispatch (see class docstring).
@@ -593,9 +771,9 @@ class MoE(nn.Module):
         xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(self.dtype), x)
         xe = nn.with_logical_constraint(
             xe, ("expert", "batch", None, "embed"))
-        h = nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, w_gate)) * \
-            jnp.einsum("ebcd,edf->ebcf", xe, w_in)
-        y = jnp.einsum("ebcf,efd->ebcd", h, w_out)
+        h = nn.silu(self._emm("ebcd,edf->ebcf", xe, w_gate)) * \
+            self._emm("ebcd,edf->ebcf", xe, w_in)
+        y = self._emm("ebcf,efd->ebcd", h, w_out)
         y = nn.with_logical_constraint(
             y, ("expert", "batch", None, "embed"))
         out = jnp.einsum("ebcd,bsec->bsd", y,
@@ -614,22 +792,25 @@ class Block(nn.Module):
     capacity_factor: float = 1.25
     moe_top_k: int = 1
     moe_group_size: int = 0
+    quantize: bool = False        # int8 weight-only matmuls (serve)
 
     @nn.compact
     def __call__(self, x, cos, sin, decode: bool = False):
         h = RMSNorm(dtype=self.dtype, name="ln_attn")(x)
         x = x + Attention(self.n_heads, self.head_dim, self.attn_impl,
-                          self.dtype, name="attn")(h, cos, sin,
-                                                   decode=decode)
+                          self.dtype, quantize=self.quantize,
+                          name="attn")(h, cos, sin, decode=decode)
         h = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
         if self.n_experts > 0:
             x = x + MoE(self.n_experts, self.d_ff, self.dtype,
                         dispatch=self.moe_dispatch,
                         capacity_factor=self.capacity_factor,
                         top_k=self.moe_top_k,
-                        group_size=self.moe_group_size, name="moe")(h)
+                        group_size=self.moe_group_size,
+                        quantize=self.quantize, name="moe")(h)
         else:
-            x = x + SwiGLU(self.d_ff, self.dtype, name="mlp")(h)
+            x = x + SwiGLU(self.d_ff, self.dtype,
+                           quantize=self.quantize, name="mlp")(h)
         return x
 
 
@@ -650,18 +831,35 @@ class TransformerLM(nn.Module):
     attn_impl: str = "flash"
     remat: bool = False
     dtype: Dtype = jnp.bfloat16
+    # int8 weight-only serving: every matmul kernel becomes an int8
+    # tensor + per-output-channel f32 scale with dequant fused into the
+    # matmul (dtdl_tpu/quant/).  A quantized model is built as
+    # ``model.clone(quantize=True)`` and loaded via
+    # ``quant.quantize_params`` — never trained.  Embedding, norms and
+    # MoE routers stay f32 (see dtdl_tpu/quant/core.py for why).
+    quantize: bool = False
 
     @property
     def head_dim(self):
         return self.d_model // self.n_heads
 
-    def cache_shapes(self, batch_size: int, per_slot_index: bool = False):
+    def cache_shapes(self, batch_size: int, per_slot_index: bool = False,
+                     kv_dtype=None):
         """Abstract (ShapeDtypeStruct) KV-cache pytree for ``batch_size``
         rows — one [B, H, max_seq, head_dim] K/V buffer pair + position
         index per block, no compute (``jax.eval_shape`` of the decode
         init trace).  ``per_slot_index=True`` widens the index leaves from
         a scalar to [B] — the serving-arena layout where each row is an
-        independent slot at its own decode position."""
+        independent slot at its own decode position.
+
+        ``kv_dtype='int8'`` is the **quantized** cache layout
+        (dtdl_tpu/quant): the K/V buffers become int8 and each gains a
+        per-(row, head, position) f32 ``*_scale`` sibling [B, H,
+        max_seq] — :meth:`Attention._decode_attend` quantizes on scatter
+        and dequants in the attention einsums on gather, so decode HBM
+        traffic per cached byte halves vs bf16 (quarters vs f32) at the
+        cost of one scale float per position per head."""
+        kv_dtype = canon_kv_dtype(kv_dtype)
         shapes = jax.eval_shape(
             functools.partial(self.init, decode=True),
             jax.random.PRNGKey(0),
@@ -670,16 +868,32 @@ class TransformerLM(nn.Module):
             shapes = jax.tree.map(
                 lambda s: jax.ShapeDtypeStruct((batch_size,), s.dtype)
                 if s.ndim == 0 else s, shapes)
+        if kv_dtype is not None:
+            def conv(tree):
+                if isinstance(tree, dict):
+                    if "key" in tree and "index" in tree:
+                        kv = tree["key"].shape          # [B, H, S, D]
+                        sc = jax.ShapeDtypeStruct(kv[:3], jnp.float32)
+                        return dict(
+                            tree,
+                            key=jax.ShapeDtypeStruct(kv, kv_dtype),
+                            value=jax.ShapeDtypeStruct(kv, kv_dtype),
+                            key_scale=sc, value_scale=sc)
+                    return {k: conv(v) for k, v in tree.items()}
+                return tree
+            shapes = conv(shapes)
         return shapes
 
-    def init_cache(self, batch_size: int, per_slot_index: bool = False):
+    def init_cache(self, batch_size: int, per_slot_index: bool = False,
+                   kv_dtype=None):
         """Fresh zero KV cache (see :meth:`cache_shapes`); ``max_seq`` of
         the result is recoverable via :func:`cache_max_seq`."""
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                            self.cache_shapes(batch_size, per_slot_index))
+                            self.cache_shapes(batch_size, per_slot_index,
+                                              kv_dtype))
 
     def paged_cache_shapes(self, n_slots: int, n_pages: int,
-                           page_size: int):
+                           page_size: int, kv_dtype=None):
         """Abstract pytree of the **block-paged** serving arena: per
         block, a shared ``pages_key``/``pages_value`` pool of
         ``[n_pages, H, page_size, head_dim]`` plus the per-slot
@@ -688,7 +902,18 @@ class TransformerLM(nn.Module):
         ``page_table``/``active`` leaves are inserted by the serving
         engine, not stored).  Page 0 is reserved as the garbage page,
         hence ``n_pages >= 2``; ``page_size`` must divide ``max_seq`` so
-        the gathered logical view covers exactly the rope table."""
+        the gathered logical view covers exactly the rope table.
+
+        ``kv_dtype='int8'`` quantizes the pools: int8
+        ``pages_key``/``pages_value`` plus per-(page, head, in-page
+        position) f32 ``pages_key_scale``/``pages_value_scale``
+        [n_pages, H, page_size] — each K/V page byte halves vs bf16, so
+        a fixed HBM pool holds ~2x the pages (the slots-per-byte
+        multiplier the serving engine's ``kv_pool_bytes`` sizing and
+        compile_stats receipts expose).  Scales ride WITH their page
+        (scattered/gathered through the same page table), so prefix-
+        cache sharing of int8 pages needs no extra bookkeeping."""
+        kv_dtype = canon_kv_dtype(kv_dtype)
         if page_size < 1 or self.max_seq % page_size:
             raise ValueError(
                 f"page_size must be >= 1 and divide max_seq="
@@ -701,26 +926,31 @@ class TransformerLM(nn.Module):
             if isinstance(tree, dict):
                 if "key" in tree and "index" in tree:
                     _, H, _, D = tree["key"].shape
-                    return {
+                    pool_dt = kv_dtype or tree["key"].dtype
+                    out = {
                         "pages_key": jax.ShapeDtypeStruct(
-                            (n_pages, H, page_size, D),
-                            tree["key"].dtype),
+                            (n_pages, H, page_size, D), pool_dt),
                         "pages_value": jax.ShapeDtypeStruct(
-                            (n_pages, H, page_size, D),
-                            tree["value"].dtype),
+                            (n_pages, H, page_size, D), pool_dt),
                         "index": jax.ShapeDtypeStruct(
                             (n_slots,), jnp.int32),
                     }
+                    if kv_dtype is not None:
+                        sc = jax.ShapeDtypeStruct(
+                            (n_pages, H, page_size), jnp.float32)
+                        out["pages_key_scale"] = sc
+                        out["pages_value_scale"] = sc
+                    return out
                 return {k: conv(v) for k, v in tree.items()}
             return tree
         return conv(self.cache_shapes(1))
 
     def init_paged_cache(self, n_slots: int, n_pages: int,
-                         page_size: int):
+                         page_size: int, kv_dtype=None):
         """Fresh zeroed paged arena (see :meth:`paged_cache_shapes`)."""
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                             self.paged_cache_shapes(n_slots, n_pages,
-                                                    page_size))
+                                                    page_size, kv_dtype))
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,
@@ -760,6 +990,7 @@ class TransformerLM(nn.Module):
                 capacity_factor=self.capacity_factor,
                 moe_top_k=self.moe_top_k,
                 moe_group_size=self.moe_group_size,
+                quantize=self.quantize,
                 name=f"block_{i}")
             # only pass the flag when set: a kwarg through nn.remat is
             # traced, and Attention branches on it in Python

@@ -1317,7 +1317,9 @@ def place_params(mesh: Mesh, cfg: MegatronConfig, params: dict) -> dict:
 
 
 def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
-                 n_slots: int = 8, buckets=None, **overrides):
+                 n_slots: int = 8, buckets=None, page_size: int = 0,
+                 n_pages: int = None, quantize_weights: bool = False,
+                 kv_dtype=None, kv_pool_bytes: int = None, **overrides):
     """Train on the 4D engine, serve through dtdl_tpu.serve — the full
     bridge in one call: :func:`to_flax_model` (geometry) +
     :func:`to_flax_params` (weights) + an
@@ -1339,6 +1341,14 @@ def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
     applied before conversion).  ``overrides`` reach
     :func:`to_flax_model` — e.g. ``max_seq=4096`` to serve longer than
     the trained context.
+
+    The engine-geometry kwargs pass straight through to
+    :class:`~dtdl_tpu.serve.InferenceEngine`: ``page_size``/``n_pages``/
+    ``kv_pool_bytes`` build the block-paged arena (prefix caching is
+    scheduler policy on top), ``quantize_weights``/``kv_dtype`` the int8
+    serving variants (dtdl_tpu/quant) — quantization happens AFTER the
+    4D→flax conversion, so a bf16/f32 training snapshot serves int8
+    without retraining.
     """
     from dtdl_tpu.serve import InferenceEngine
 
@@ -1348,4 +1358,8 @@ def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
         fparams = jax.tree.map(
             lambda p: jax.device_put(p, NamedSharding(mesh, P())), fparams)
     return InferenceEngine(model, fparams, n_slots=n_slots,
-                           buckets=buckets)
+                           buckets=buckets, page_size=page_size,
+                           n_pages=n_pages,
+                           quantize_weights=quantize_weights,
+                           kv_dtype=kv_dtype,
+                           kv_pool_bytes=kv_pool_bytes)

@@ -1,0 +1,21 @@
+"""int8 quantization for serving: weight-only matmuls + int8 KV.
+
+The serve-stack entry point is one engine kwarg away —
+
+    engine = InferenceEngine(model, params,
+                             quantize_weights=True,   # int8 weights
+                             kv_dtype="int8")         # int8 KV arena
+
+— which quantizes the (f32/bf16) params into the QuantizedParams
+pytree, swaps the model for its ``quantize=True`` clone (dequant-in-
+kernel matmuls), and builds the int8+scales KV arena the attention
+paths consume.  Same three compiled program families, zero new
+programs; see dtdl_tpu/quant/core.py for the recipe and the byte
+arithmetic, tests/test_quant.py for the parity contracts.
+"""
+
+from dtdl_tpu.quant.core import (  # noqa: F401
+    SCALE_SUFFIX, canon_kv_dtype, dequantize_params, kv_quantize,
+    quantize_params, quantize_tensor, tree_bytes,
+)
+from dtdl_tpu.quant.layers import QuantDenseGeneral  # noqa: F401

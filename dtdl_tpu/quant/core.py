@@ -1,0 +1,205 @@
+"""Symmetric int8 quantization for the serve stack (weights + KV).
+
+Decode is HBM-bandwidth-bound: a batch of slots advancing one token
+re-reads every parameter byte and every live KV byte once, so tokens/sec
+is (param_bytes + kv_bytes) / bandwidth (SCALING.md "Serving latency
+model") and shrinking the bytes IS the speedup.  This module is the
+byte-shrinking half of that equation; the kernels that consume its
+output live in dtdl_tpu/quant/layers.py (weights) and
+models/transformer.py (KV).
+
+**Weights** — the LLM.int8/AWQ-style *weight-only* recipe: every matmul
+kernel is stored as an int8 tensor plus an f32 scale per OUTPUT feature
+(symmetric per-channel: ``scale_c = max|w[..., c]| / 127``).  Because
+the scale is constant along the contracted dims, it factors out of the
+matmul —
+
+    x @ (q * s)  ==  (x @ q) * s        (s per output column)
+
+— so the dequant is a cheap multiply on the small matmul *output*, the
+int8 kernel is converted to the compute dtype inside the fused matmul
+read (registers/VMEM, never a materialized f32 weight copy in HBM), and
+HBM parameter traffic drops to one byte per weight.  Activations stay in
+the model dtype throughout: accuracy is per-channel-rounding only,
+|w - q·s| <= s/2 elementwise, and the serve contract is the measured
+logits-parity tolerance in tests/test_quant.py, not an asserted one.
+
+Quantized sites (the matmul weights, i.e. where the decode bytes are):
+attention q/k/v/out projections, the SwiGLU wi/wg/wo, and MoE expert
+wi/wg/wo (per-expert per-output-channel scales).  Deliberately NOT
+quantized: the embedding (its decode-path read is a one-row gather, not
+a matmul sweep, and it doubles as the output head — quantizing it
+perturbs every logit directly for no bandwidth win on the gather),
+RMSNorm scales and the MoE router (O(d) vectors, noise in the byte
+budget, high sensitivity).
+
+**KV** — int8 cache rows with an f32 scale per (row, head, position)
+for the dense arena and per (page, head, in-page position) for the
+paged pool: quantize-on-scatter (each new K/V row is scaled off its own
+max — write-once, so append-only pages never need rescaling), dequant
+fused into the attention einsums on gather (the key scale multiplies
+the [.., positions]-shaped logits, the value scale folds into the
+softmax weights — no dequantized [.., D] copy is ever materialized).
+See models/transformer.py `_verify_attend_slots` / `_paged_attend_slots`.
+
+The **QuantizedParams pytree** returned by :func:`quantize_params` is a
+plain nested dict with the SAME module paths as the source params —
+each quantized kernel keeps its name and gains an ``<name>_scale``
+sibling — matching what ``model.clone(quantize=True)`` declares, so the
+serving engine can swap quantized weights in without touching any
+program structure (same three compiled program families, pinned by
+RecompileSentinel in tests/test_quant.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: suffix linking a quantized tensor to its scale in the params pytree
+SCALE_SUFFIX = "_scale"
+
+
+def canon_kv_dtype(kv_dtype):
+    """Normalize a ``kv_dtype`` argument: ``None`` (store K/V at the
+    model dtype — today's behavior) or int8 (accepts ``jnp.int8`` /
+    ``np.int8`` / ``"int8"``), anything else is a named error."""
+    if kv_dtype is None:
+        return None
+    try:
+        if np.dtype(kv_dtype) == np.dtype(np.int8):
+            return jnp.int8
+    except TypeError:
+        pass
+    raise ValueError(f"kv_dtype must be None (model dtype) or int8, "
+                     f"got {kv_dtype!r}")
+
+
+def quantize_tensor(w, scale_shape):
+    """Symmetric per-channel int8 of one weight tensor.
+
+    ``scale_shape`` is ``w.shape`` with every *contracted* (input) dim
+    set to 1 — the keepdims layout the quantized modules declare, which
+    is what makes this function generic over Dense / DenseGeneral /
+    per-expert kernels: the 1-dims name the reduction axes.  Returns
+    ``(q int8, scale f32)`` with ``w ≈ q * scale`` (broadcast) and
+    ``|w - q·scale| <= scale/2`` elementwise; all-zero channels get
+    scale 1 so nothing divides by zero.
+    """
+    w = jnp.asarray(w)
+    if len(scale_shape) != w.ndim or any(
+            s not in (1, d) for s, d in zip(scale_shape, w.shape)):
+        raise ValueError(f"scale shape {tuple(scale_shape)} does not "
+                         f"broadcast against weight shape {w.shape}")
+    axes = tuple(i for i, s in enumerate(scale_shape) if s == 1)
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True) if axes \
+        else jnp.abs(w32)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_params(model, params):
+    """f32/bf16 params -> the QuantizedParams pytree of
+    ``model.clone(quantize=True)``.
+
+    ``model`` is the UNQUANTIZED model the params belong to; its
+    quantized clone's abstract param tree (``jax.eval_shape`` of init —
+    no compute) is the schema: wherever that tree carries a
+    ``<name>_scale`` sibling, ``params[<name>]`` is quantized with
+    :func:`quantize_tensor` (the scale's keepdims shape names the
+    reduction axes); every other leaf passes through untouched (embed,
+    norms, router — see module docstring).  Structure mismatches raise
+    with the offending path instead of silently dropping weights.
+    """
+    import flax.linen as nn
+
+    qmodel = model.clone(quantize=True)
+    params = nn.unbox(params)
+    shapes = nn.unbox(jax.eval_shape(
+        qmodel.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, 1), jnp.int32))["params"])
+
+    def conv(src, ref, path):
+        if not isinstance(ref, dict):
+            return src
+        if not isinstance(src, dict):
+            raise ValueError(f"params mismatch at {'/'.join(path)}: "
+                             f"expected a dict, got {type(src).__name__}")
+        out = {}
+        for name, sub in ref.items():
+            base = name[:-len(SCALE_SUFFIX)]
+            if name.endswith(SCALE_SUFFIX) and base in ref:
+                continue                      # emitted with its tensor
+            if name not in src:
+                raise ValueError(f"params are missing "
+                                 f"{'/'.join(path + (name,))}")
+            if f"{name}{SCALE_SUFFIX}" in ref:
+                if f"{name}{SCALE_SUFFIX}" in src:
+                    # a scale sibling in the SOURCE means the tree is
+                    # already quantized — re-quantizing would drop the
+                    # real scales and re-round the int8 payload as if
+                    # it were float weights (silent garbage)
+                    raise ValueError(
+                        f"params already carry "
+                        f"{'/'.join(path + (name + SCALE_SUFFIX,))}: "
+                        f"the tree is already quantized")
+                q, s = quantize_tensor(
+                    src[name], ref[f"{name}{SCALE_SUFFIX}"].shape)
+                out[name], out[f"{name}{SCALE_SUFFIX}"] = q, s
+            else:
+                out[name] = conv(src[name], sub, path + (name,))
+        extra = set(src) - set(out)
+        if extra:
+            raise ValueError(f"unexpected params under "
+                             f"{'/'.join(path) or '<root>'}: "
+                             f"{sorted(extra)}")
+        return out
+
+    return conv(params, shapes, ())
+
+
+def dequantize_params(qparams):
+    """Inverse of :func:`quantize_params` up to per-channel rounding:
+    every ``(q, <name>_scale)`` pair becomes the f32 ``q * scale`` —
+    the reference the parity tests diff the in-kernel dequant against."""
+    def conv(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for name, sub in tree.items():
+            base = name[:-len(SCALE_SUFFIX)]
+            if name.endswith(SCALE_SUFFIX) and base in tree:
+                continue
+            scale = tree.get(f"{name}{SCALE_SUFFIX}")
+            if scale is not None:
+                out[name] = jnp.asarray(sub, jnp.float32) * scale
+            else:
+                out[name] = conv(sub)
+        return out
+    return conv(qparams)
+
+
+def kv_quantize(x):
+    """Per-(…, position) symmetric int8 for a K/V tensor ``[..., D]``:
+    returns ``(q int8 [..., D], scale f32 [...])`` with
+    ``x ≈ q * scale[..., None]``.  The scale comes from the new row's
+    own max — write-once, so a cache position never needs rescaling
+    after later writes (the append-only discipline int8 KV arenas
+    require)."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(x32 / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of a pytree of arrays or ShapeDtypeStructs — the
+    byte receipts ``InferenceEngine.compile_stats`` reports."""
+    return int(sum(math.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+                   for leaf in jax.tree.leaves(tree)))

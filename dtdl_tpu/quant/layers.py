@@ -1,0 +1,63 @@
+"""int8 weight-only dense layers — dequant-in-kernel matmuls.
+
+:class:`QuantDenseGeneral` is a drop-in for the bias-free
+``nn.DenseGeneral`` the transformer's projections use: same module name,
+same ``kernel`` param name and shape (so a quantized params tree keeps
+the f32 tree's module paths — dtdl_tpu/quant/core.py), plus a
+``kernel_scale`` param in the keepdims per-output-feature layout.  The
+forward is the scale-fused ``lax.dot_general``:
+
+    y = dot_general(x, q.astype(dtype)) * scale
+
+The int8→dtype convert is element-wise on a dot operand, which XLA
+fuses into the matmul's HBM read — the weight crosses HBM as ONE byte
+per element and no f32/bf16 copy of it is ever materialized.  Because
+the scale is per output channel (constant along every contracted dim)
+the output multiply is *exactly* the dequantized matmul, not an
+approximation of it: the only error vs f32 is the per-channel rounding
+of the stored int8 (|w - q·s| <= s/2, dtdl_tpu/quant/core.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class QuantDenseGeneral(nn.Module):
+    """Bias-free ``nn.DenseGeneral`` over an int8 kernel + f32
+    per-output-feature scale (see module docstring).  ``axis`` names the
+    input dims to contract (the transformer uses ``-1`` for q/k/v/mlp
+    and ``(-2, -1)`` for the attention out-projection); params are
+    ``kernel`` int8 ``[*in_dims, *features]`` and ``kernel_scale`` f32
+    ``[1…1, *features]`` — init yields placeholder zeros/ones, real
+    values come from ``quantize_params`` (a quantized model is never
+    trained, only served)."""
+
+    features: Any          # int or tuple of output feature dims
+    axis: Any = -1         # int or tuple of input axes to contract
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        features = (self.features if isinstance(self.features, tuple)
+                    else (self.features,))
+        axis = self.axis if isinstance(self.axis, tuple) else (self.axis,)
+        axis = tuple(sorted(a % x.ndim for a in axis))
+        in_shape = tuple(x.shape[a] for a in axis)
+        n_in = len(in_shape)
+        kernel = self.param(
+            "kernel", lambda *_: jnp.zeros(in_shape + features, jnp.int8))
+        scale = self.param(
+            "kernel_scale",
+            lambda *_: jnp.ones((1,) * n_in + features, jnp.float32))
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            ((axis, tuple(range(n_in))), ((), ())))
+        # scale-fused dequant: f32 multiply on the (small) matmul output,
+        # cast back to the compute dtype — bitwise the dequantized matmul
+        # for f32 models, one rounding for bf16
+        return (y * scale.reshape(features)).astype(self.dtype)

@@ -78,6 +78,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dtdl_tpu.quant import canon_kv_dtype, quantize_params, tree_bytes
 from dtdl_tpu.serve.sampling import (SampleParams, accept_resample, pack,
                                      sample)
 
@@ -153,13 +154,38 @@ class InferenceEngine:
     defaults to dense-equivalent capacity
     (``n_slots * max_seq / page_size + 1``); undersizing it overcommits
     HBM and shifts admission to the scheduler's page accounting
-    (dtdl_tpu/serve/paged.py)."""
+    (dtdl_tpu/serve/paged.py).
+
+    **Quantized serving** (dtdl_tpu/quant) is two more kwargs.
+    ``quantize_weights=True`` swaps the model for its
+    ``clone(quantize=True)`` (int8 kernels, dequant fused into every
+    matmul) and converts the given f32/bf16 params through
+    ``quant.quantize_params`` at construction — decode's per-token
+    parameter read drops to one byte per weight.  ``kv_dtype='int8'``
+    builds the int8+scales arena variant (dense or paged), halving
+    K/V bytes vs bf16 (quartering vs f32) with quantize-on-scatter /
+    dequant-on-gather folded into the attention programs.  Both ride
+    the SAME three program families — quantization is weights+arena
+    layout, never a new compile shape — and ``compile_stats()['quant']``
+    carries the exact byte receipts.  For paged arenas,
+    ``kv_pool_bytes`` sizes ``n_pages`` from an HBM byte budget
+    instead: at a fixed budget an int8 pool holds ~2x the pages of a
+    bf16 one (~4x an f32 one) — the slots-per-HBM-byte win."""
 
     def __init__(self, model, params, n_slots: int = 8, buckets=None,
                  observer=None, page_size: int = 0,
-                 n_pages: int | None = None):
+                 n_pages: int | None = None,
+                 quantize_weights: bool = False, kv_dtype=None,
+                 kv_pool_bytes: int | None = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.quantized_weights = bool(quantize_weights)
+        self.kv_dtype = canon_kv_dtype(kv_dtype)
+        if quantize_weights:
+            # params are the UNQUANTIZED tree the caller trained/loaded;
+            # the quantized clone declares the int8+scale schema
+            params = quantize_params(model, params)
+            model = model.clone(quantize=True)
         self.model = model
         self.params = nn.unbox(params)   # plain leaves either way
         # obs facade: when set (directly or by the Scheduler), the
@@ -176,11 +202,36 @@ class InferenceEngine:
                              f"max_seq={model.max_seq}")
         self.paged = page_size > 0
         self.page_size = page_size
+        self.page_bytes = 0
         if self.paged:
             if model.max_seq % page_size:
                 raise ValueError(f"page_size={page_size} must divide "
                                  f"max_seq={model.max_seq}")
             self.n_ptab = model.max_seq // page_size
+            # bytes ONE page pair costs across all blocks (K/V pages
+            # plus, for int8, their scale rows) — the pool-sizing and
+            # capacity-receipt arithmetic
+            self.page_bytes = (
+                tree_bytes(model.paged_cache_shapes(
+                    1, 3, page_size, self.kv_dtype))
+                - tree_bytes(model.paged_cache_shapes(
+                    1, 2, page_size, self.kv_dtype)))
+            if kv_pool_bytes is not None:
+                if n_pages is not None:
+                    raise ValueError("pass n_pages or kv_pool_bytes, "
+                                     "not both")
+                # fixed HBM budget -> as many pages as it holds (the
+                # garbage page is part of the pool, so no +1); a
+                # budget below the 2-page floor raises like every
+                # other undersized geometry instead of silently
+                # allocating past the caller's stated bytes
+                n_pages = kv_pool_bytes // self.page_bytes
+                if n_pages < 2:
+                    raise ValueError(
+                        f"kv_pool_bytes={kv_pool_bytes} holds "
+                        f"{n_pages} pages of {self.page_bytes} bytes; "
+                        f"the pool needs >= 2 (garbage page + one "
+                        f"live page)")
             self.n_pages = (n_pages if n_pages is not None
                             else n_slots * self.n_ptab + 1)
             if self.n_pages < 2:
@@ -189,10 +240,12 @@ class InferenceEngine:
         else:
             if n_pages is not None:
                 raise ValueError("n_pages requires page_size > 0")
+            if kv_pool_bytes is not None:
+                raise ValueError("kv_pool_bytes requires page_size > 0")
             self.n_ptab = 0
             self.n_pages = 0
         # single-row cache template the dense prefill program zero-fills
-        self._cache1 = model.cache_shapes(1)
+        self._cache1 = model.cache_shapes(1, kv_dtype=self.kv_dtype)
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None
         self._verify_fns: dict[int, object] = {}
@@ -207,10 +260,8 @@ class InferenceEngine:
     def init_arena(self):
         """Fresh zeroed KV arena (donated to every program): dense
         [n_slots, max_seq] rows, or the paged pool + per-slot indices."""
-        if self.paged:
-            return self.model.init_paged_cache(
-                self.n_slots, self.n_pages, self.page_size)
-        return self.model.init_cache(self.n_slots, per_slot_index=True)
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            self.arena_shapes())
 
     def init_last_tokens(self):
         """The [n_slots] last-sampled-token vector (NOT donated: the
@@ -253,8 +304,10 @@ class InferenceEngine:
                 if n.ndim == 0:   # index leaf: the true prompt length,
                     return jax.lax.dynamic_update_slice(   # not bucket T
                         a, length[None].astype(a.dtype), (slot,))
+                # K/V buffers [1,H,S,D] and (int8 arenas) their scale
+                # rows [1,H,S] land in arena row `slot`
                 return jax.lax.dynamic_update_slice(
-                    a, n.astype(a.dtype), (slot, 0, 0, 0))
+                    a, n.astype(a.dtype), (slot,) + (0,) * (n.ndim - 1))
             arena = jax.tree.map(write, arena, muts["cache"])
             last = jax.lax.dynamic_update_slice(last, tok, (slot,))
             return arena, last, logits[0]
@@ -364,6 +417,16 @@ class InferenceEngine:
 
         return jax.jit(verify, donate_argnums=(1,))
 
+    def arena_shapes(self):
+        """Abstract pytree of the engine's KV arena (no allocation)."""
+        if self.paged:
+            return self.model.paged_cache_shapes(
+                self.n_slots, self.n_pages, self.page_size,
+                self.kv_dtype)
+        return self.model.cache_shapes(self.n_slots,
+                                       per_slot_index=True,
+                                       kv_dtype=self.kv_dtype)
+
     def compile_stats(self) -> dict:
         """Compiled-program counts — the no-per-request-recompile
         receipt: one entry per touched prefill bucket, one per touched
@@ -374,19 +437,52 @@ class InferenceEngine:
         same shape as a dense one's — page tables are data, not shapes.
         (Per-call occupancy — pages_in_use, prefix hit rates — is
         scheduler state, reported by ServeMetrics; this dict stays
-        constant across calls so receipts can be compared.)"""
+        constant across calls so receipts can be compared.)
+
+        ``quant`` is the BYTE receipt of the quantization layer
+        (SCALING.md "Quantized serving arithmetic"): ``param_bytes``
+        (what every decode step re-reads), the arena split into K/V
+        payload vs int8 scale sidecars, and
+        ``decode_hbm_bytes_per_token`` — the full-occupancy
+        bandwidth-model upper bound ``(param_bytes + kv_arena_bytes) /
+        n_slots``, i.e. the numerator of the serving-latency roofline;
+        shrinking it IS the TPU decode speedup."""
         def n(f):
             try:
                 return f._cache_size()
             except AttributeError:   # pragma: no cover - jax internals
                 return -1
+        payload = scales = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.arena_shapes())[0]:
+            name = path[-1].key
+            nbytes = (int(np.prod(leaf.shape))
+                      * np.dtype(leaf.dtype).itemsize)
+            if name.endswith("_scale"):
+                scales += nbytes
+            elif name != "index":
+                payload += nbytes
+        param_bytes = tree_bytes(self.params)
         return {"prefill": {T: n(f) for T, f in self._prefill_fns.items()},
                 "decode": n(self._decode_fn) if self._decode_fn else 0,
                 "verify": {k: n(f) for k, f in self._verify_fns.items()},
                 "paged": ({"page_size": self.page_size,
                            "n_pages": self.n_pages,
-                           "pages_per_slot": self.n_ptab}
-                          if self.paged else None)}
+                           "pages_per_slot": self.n_ptab,
+                           "page_bytes": self.page_bytes}
+                          if self.paged else None),
+                "quant": {
+                    "weights": self.quantized_weights,
+                    "kv_dtype": ("int8" if self.kv_dtype is not None
+                                 else None),
+                    "param_bytes": param_bytes,
+                    "kv_payload_bytes": payload,
+                    "kv_scale_bytes": scales,
+                    "kv_arena_bytes": payload + scales,
+                    "decode_hbm_bytes_per_token": round(
+                        (param_bytes + payload + scales)
+                        / self.n_slots),
+                }}
 
     # ---- the two entry points ----------------------------------------
 

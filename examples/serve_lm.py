@@ -67,6 +67,11 @@ def main():
          help="synthetic traffic: give every request this many common "
               "leading tokens (a system prompt) so the prefix cache "
               "has something to hit")
+    flag(parser, "--quantize", default="none",
+         choices=["none", "w8", "w8kv8"],
+         help="int8 serving (dtdl_tpu/quant): w8 = weight-only int8 "
+              "matmuls, w8kv8 = + int8 KV arena; same compiled "
+              "programs, ~4x less parameter HBM traffic")
     flag(parser, "--seed", type=int, default=0)
     flag(parser, "--trace", default="",
          help="write a Chrome-trace-event JSON (Perfetto-loadable) of "
@@ -90,7 +95,10 @@ def main():
     obs = Observer(trace_path=args.trace or None, sentinel="warn")
     engine = InferenceEngine(model, params, n_slots=args.n_slots,
                              observer=obs, page_size=args.page_size,
-                             n_pages=args.n_pages or None)
+                             n_pages=args.n_pages or None,
+                             quantize_weights=args.quantize != "none",
+                             kv_dtype=("int8" if args.quantize == "w8kv8"
+                                       else None))
     draft = None
     if args.speculate and args.draft == "model":
         # demo draft transformer: a narrower random-init LM sharing the
@@ -150,6 +158,29 @@ def main():
               f"{s['pages_in_use_last']}/{s['page_capacity']} "
               f"(peak {s['pages_in_use_peak']})  shed "
               f"{s['requests_shed']}")
+    if args.quantize != "none":
+        # the quantization receipts: decode bytes/token (the TPU
+        # roofline numerator), KV capacity gained at fixed HBM, and the
+        # measured logits drift of int8 rounding on a probe prompt
+        q = engine.compile_stats()["quant"]
+        ref = InferenceEngine(model, params, n_slots=args.n_slots,
+                              page_size=args.page_size,
+                              n_pages=args.n_pages or None)
+        rq = ref.compile_stats()["quant"]
+        kv_x = (rq["kv_arena_bytes"] / q["kv_arena_bytes"]
+                if q["kv_arena_bytes"] else 1.0)
+        probe = jnp.asarray([reqs[0].prompt], jnp.int32)
+        lf = model.apply({"params": params}, probe)
+        lq = engine.model.apply({"params": engine.params}, probe)
+        drift = float(jnp.max(jnp.abs(lf - lq))) \
+            / max(float(jnp.max(jnp.abs(lf))), 1e-9)
+        print(f"  quantized ({args.quantize}): decode bytes/token "
+              f"{q['decode_hbm_bytes_per_token']:,} (f32: "
+              f"{rq['decode_hbm_bytes_per_token']:,})  param bytes "
+              f"{q['param_bytes']:,} ({rq['param_bytes']:,} f32)  "
+              f"kv capacity x{kv_x:.2f} at fixed HBM "
+              f"(~{int(args.n_slots * kv_x)} slots for these "
+              f"{args.n_slots})  probe logits drift {drift:.1%}")
     if args.speculate:
         # per-request ACCEPTED tokens/sec (delivered tokens over the
         # request's own decode window) — the user-visible spec win

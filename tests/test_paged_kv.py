@@ -275,7 +275,10 @@ def test_paged_compile_receipts_zero_recompiles(engine, obs):
         all(n == 1 for n in stats["prefill"].values()), stats
     assert stats["paged"] == {"page_size": PAGE,
                               "n_pages": 2 * (MAX_SEQ // PAGE) + 1,
-                              "pages_per_slot": MAX_SEQ // PAGE}
+                              "pages_per_slot": MAX_SEQ // PAGE,
+                              # one K/V page pair across both blocks:
+                              # 2 layers · 2 bufs · [H=2, PAGE, D=16] f32
+                              "page_bytes": 2 * 2 * 2 * PAGE * 16 * 4}
     assert obs.sentinel.summary()["recompile_events"] == 0
 
 

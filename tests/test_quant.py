@@ -1,0 +1,433 @@
+"""Quantized serving: the ISSUE-7 contracts (dtdl_tpu/quant).
+
+Same tiny f32 dense config as tests/test_serve.py.  The module keeps ONE
+shared w8+kv8 *paged* engine (watched by a RecompileSentinel at
+policy='raise' from construction) so the end-to-end tests double as the
+zero-recompile pin, and the byte-receipt tests construct engines without
+ever compiling a program (lazy program builds).
+
+* **rounding bounds** — `quantize_tensor` / `kv_quantize` reconstruct
+  within half a quantization step per channel/row, by construction;
+* **logits parity** — the quantized model (w8) and the quantized engine
+  prefill (w8 and w8+kv8) match their f32 counterparts within a STATED
+  tolerance (5% of the logit range — per-channel int8 rounding only);
+* **token identity** — greedy decode is argmax over near-identical
+  logits: the w8+kv8 paged engine reproduces the f32 solo eager decode
+  token-for-token on the pinned mixed spec/non-spec traffic, through
+  prefix-cache hits, and on the dense int8 arena;
+* **byte receipts** — `compile_stats()['quant']`: int8 weights shrink
+  param bytes ~4x (f32 model), the int8 arena is less than half the f32
+  arena, and a fixed `kv_pool_bytes` budget holds >= 2x the pages;
+* **program count** — still exactly three compiled program families
+  (prefill-per-bucket / decode / verify-per-k); quantization is weights
+  + arena layout, never a compile shape.
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from dtdl_tpu.models.transformer import transformer_lm
+from dtdl_tpu.obs import Observer
+from dtdl_tpu.quant import (
+    canon_kv_dtype, dequantize_params, kv_quantize, quantize_params,
+    quantize_tensor, tree_bytes,
+)
+from dtdl_tpu.serve import (
+    InferenceEngine, NGramDraft, Request, SampleParams, Scheduler,
+)
+
+MAX_SEQ = 48
+BUCKETS = (8, 16)
+PAGE = 8
+#: stated parity tolerance: per-channel int8 rounding perturbs each
+#: matmul by <= scale/2 per weight; on the tiny config the measured
+#: logit drift is ~2% of the logit range, pinned here at 5%
+REL_TOL = 0.05
+
+
+@pytest.fixture(scope="module")
+def model():
+    return transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, attn_impl="dense", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return nn.unbox(model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))["params"])
+
+
+@pytest.fixture(scope="module")
+def obs():
+    return Observer(sentinel="raise")
+
+
+@pytest.fixture(scope="module")
+def qengine(model, params, obs):
+    """THE shared engine: int8 weights + int8 paged KV, sentinel at
+    policy='raise' from construction — every dispatch in this module
+    raises on a genuine retrace."""
+    return InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                           page_size=PAGE, observer=obs,
+                           quantize_weights=True, kv_dtype="int8")
+
+
+def ref_greedy(model, params, prompt, n_new):
+    """One-at-a-time eager f32 reference (same oracle as
+    tests/test_serve.py)."""
+    cache = model.init_cache(1)
+    _, m = model.apply({"params": params, "cache": cache},
+                       jnp.asarray([prompt], jnp.int32), decode=True,
+                       mutable=["cache"])
+    logits = model.apply({"params": params},
+                         jnp.asarray([prompt], jnp.int32))
+    out = [int(jnp.argmax(logits[0, -1]))]
+    cache = m["cache"]
+    for _ in range(n_new - 1):
+        logits, m = model.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray([[out[-1]]], jnp.int32), decode=True,
+            mutable=["cache"])
+        cache = m["cache"]
+        out.append(int(jnp.argmax(logits[0, -1])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# quantizer math (no engine, no jit)
+# ---------------------------------------------------------------------------
+
+def test_quantize_tensor_rounding_bound():
+    """|w - q*s| <= s/2 elementwise (symmetric round-to-nearest), int8
+    payload, f32 keepdims scales; all-zero channels get scale 1."""
+    gen = np.random.default_rng(0)
+    w = gen.normal(size=(16, 8)).astype(np.float32)
+    w[:, 3] = 0.0                                  # degenerate channel
+    q, s = quantize_tensor(w, (1, 8))
+    assert q.dtype == jnp.int8 and s.shape == (1, 8)
+    assert float(s[0, 3]) == 1.0 and int(jnp.abs(q[:, 3]).max()) == 0
+    err = np.abs(w - np.asarray(q, np.float32) * np.asarray(s))
+    assert (err <= np.asarray(s) / 2 + 1e-7).all()
+    # per-OUTPUT-channel: each column's max hits 127 exactly
+    assert (np.abs(np.asarray(q))[:, [c for c in range(8) if c != 3]]
+            .max(axis=0) == 127).all()
+    with pytest.raises(ValueError, match="broadcast"):
+        quantize_tensor(w, (1, 4))
+
+
+def test_kv_quantize_rowwise_bound():
+    """Per-(..., position) scales: each D-row reconstructs within half a
+    step of its OWN max — the write-once discipline needs no global
+    calibration."""
+    gen = np.random.default_rng(1)
+    x = (gen.normal(size=(2, 3, 5, 16)) *
+         gen.lognormal(size=(2, 3, 5, 1))).astype(np.float32)
+    q, s = kv_quantize(jnp.asarray(x))
+    assert q.shape == x.shape and s.shape == x.shape[:-1]
+    err = np.abs(x - np.asarray(q, np.float32) * np.asarray(s)[..., None])
+    assert (err <= np.asarray(s)[..., None] / 2 + 1e-7).all()
+
+
+def test_canon_kv_dtype_named_error():
+    assert canon_kv_dtype(None) is None
+    assert canon_kv_dtype("int8") == jnp.int8
+    assert canon_kv_dtype(np.int8) == jnp.int8
+    with pytest.raises(ValueError, match="kv_dtype"):
+        canon_kv_dtype("int4")
+
+
+def test_quantize_params_schema_and_roundtrip(model, params):
+    """quantize_params maps tree-to-tree onto the quantized clone's
+    schema: every matmul kernel becomes int8 + a `_scale` sibling,
+    embed/norms pass through untouched, and dequantize_params inverts
+    within the per-channel rounding bound; malformed trees raise with
+    the offending path."""
+    qp = quantize_params(model, params)
+    assert qp["embed"].dtype == params["embed"].dtype   # not quantized
+    blk = qp["block_0"]["attn"]["q"]
+    assert blk["kernel"].dtype == jnp.int8
+    assert blk["kernel_scale"].shape == (1, 2, 16)      # per out-feature
+    assert qp["block_0"]["ln_attn"]["scale"].dtype != jnp.int8
+    deq = dequantize_params(qp)
+    for path, got in jax.tree_util.tree_flatten_with_path(deq)[0]:
+        want = params
+        for p in path:
+            want = want[p.key]
+        scale = qp
+        for p in path:
+            scale = scale[p.key]
+        # reconstruct within s/2 where quantized, exact elsewhere
+        assert np.allclose(got, np.asarray(want, np.float32),
+                           atol=float(np.abs(want).max()) / 127), \
+            "/".join(p.key for p in path)
+    with pytest.raises(ValueError, match="missing"):
+        quantize_params(model, {k: v for k, v in params.items()
+                                if k != "embed"})
+    # an already-quantized tree must raise, not silently re-quantize
+    # the int8 payload with fresh ~1.0 scales
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_params(model, qp)
+
+
+@pytest.mark.slow
+def test_w8_logits_parity_eager(model, params):
+    """Weight-only int8 full forward vs f32 within the stated tolerance,
+    greedy argmax identical — dense MLP and MoE variants."""
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    lf = model.apply({"params": params}, toks)
+    lq = model.clone(quantize=True).apply(
+        {"params": quantize_params(model, params)}, toks)
+    drift = float(jnp.max(jnp.abs(lf - lq)))
+    assert drift <= REL_TOL * float(jnp.max(jnp.abs(lf))), drift
+    assert (jnp.argmax(lf, -1) == jnp.argmax(lq, -1)).all()
+
+    moe = transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, n_experts=4, attn_impl="dense",
+        dtype=jnp.float32)
+    mp = nn.unbox(moe.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))["params"])
+    lmf = moe.apply({"params": mp}, toks)
+    lmq = moe.clone(quantize=True).apply(
+        {"params": quantize_params(moe, mp)}, toks)
+    drift = float(jnp.max(jnp.abs(lmf - lmq)))
+    assert drift <= REL_TOL * float(jnp.max(jnp.abs(lmf))), drift
+
+
+@pytest.mark.slow
+def test_eager_scalar_int8_kv_decode_token_identity(model, params):
+    """The scalar-index cache path (eager decode, generate()) with an
+    int8 cache: w8 model + kv_dtype='int8' cache greedy-decodes the
+    same tokens as the f32 model + f32 cache."""
+    gen = np.random.default_rng(7)
+    prompt = gen.integers(0, 64, 9).tolist()
+    want = ref_greedy(model, params, prompt, 6)
+    qmodel = model.clone(quantize=True)
+    qp = quantize_params(model, params)
+    cache = model.init_cache(1, kv_dtype="int8")
+    assert cache["block_0"]["attn"]["key"].dtype == jnp.int8
+    assert cache["block_0"]["attn"]["key_scale"].shape == (1, 2, MAX_SEQ)
+    _, m = qmodel.apply({"params": qp, "cache": cache},
+                        jnp.asarray([prompt], jnp.int32), decode=True,
+                        mutable=["cache"])
+    logits = qmodel.apply({"params": qp},
+                          jnp.asarray([prompt], jnp.int32))
+    out = [int(jnp.argmax(logits[0, -1]))]
+    cache = m["cache"]
+    for _ in range(5):
+        logits, m = qmodel.apply(
+            {"params": qp, "cache": cache},
+            jnp.asarray([[out[-1]]], jnp.int32), decode=True,
+            mutable=["cache"])
+        cache = m["cache"]
+        out.append(int(jnp.argmax(logits[0, -1])))
+    assert out == want
+
+
+# ---------------------------------------------------------------------------
+# byte receipts (engine construction only — no program compiles)
+# ---------------------------------------------------------------------------
+
+def test_arena_bytes_and_page_capacity_receipts(model, params):
+    """The acceptance arithmetic, from compile_stats: int8 weights cut
+    param bytes ~4x (f32 model; embed/norms stay f32), the int8 KV
+    arena is under HALF the f32 arena (payload exactly 4x smaller plus
+    the f32 scale sidecar), and a FIXED kv_pool_bytes budget holds at
+    least 2x the pages."""
+    f32 = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS)
+    q = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                        quantize_weights=True, kv_dtype="int8")
+    sf, sq = f32.compile_stats()["quant"], q.compile_stats()["quant"]
+    assert sf["weights"] is False and sf["kv_dtype"] is None
+    assert sq["weights"] is True and sq["kv_dtype"] == "int8"
+    assert sf["param_bytes"] == tree_bytes(params)
+    assert sq["param_bytes"] < sf["param_bytes"] / 2     # int8 kernels
+    assert sq["kv_payload_bytes"] * 4 == sf["kv_payload_bytes"]
+    assert sf["kv_scale_bytes"] == 0
+    assert sq["kv_arena_bytes"] * 2 < sf["kv_arena_bytes"]
+    assert sq["decode_hbm_bytes_per_token"] < \
+        sf["decode_hbm_bytes_per_token"] / 2
+    # paged: same HBM budget, >= 2x the pages (the slots-per-byte win)
+    budget = 256 * 1024
+    pf = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                         page_size=PAGE, kv_pool_bytes=budget)
+    pq = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                         page_size=PAGE, kv_pool_bytes=budget,
+                         kv_dtype="int8")
+    assert pq.n_pages >= 2 * pf.n_pages, (pf.n_pages, pq.n_pages)
+    assert pq.page_bytes * pq.n_pages <= budget
+    assert tree_bytes(pq.arena_shapes()) <= \
+        tree_bytes(pf.arena_shapes())
+
+
+def test_engine_quant_kwarg_validation(model, params):
+    with pytest.raises(ValueError, match="kv_dtype"):
+        InferenceEngine(model, params, kv_dtype="int4")
+    with pytest.raises(ValueError, match="kv_pool_bytes"):
+        InferenceEngine(model, params, kv_pool_bytes=1 << 20)
+    with pytest.raises(ValueError, match="not both"):
+        InferenceEngine(model, params, page_size=PAGE, n_pages=13,
+                        kv_pool_bytes=1 << 20)
+    # a budget below the 2-page floor raises instead of silently
+    # allocating past the caller's stated bytes
+    with pytest.raises(ValueError, match="holds"):
+        InferenceEngine(model, params, page_size=PAGE, kv_pool_bytes=1)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on the shared w8+kv8 paged engine (sentinel: raise)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_quantized_paged_spec_mixed_traffic_token_identity(model, params,
+                                                           qengine):
+    """THE acceptance pin: the w8+kv8 paged engine serves the pinned
+    mixed spec/non-spec greedy traffic (tests/test_paged_kv.py's
+    scenario) token-identically to the f32 solo eager decode — int8
+    pages, quantize-on-scatter, verify over quantized K/V and n-gram
+    drafts included."""
+    gen = np.random.default_rng(5)
+    lens = (5, 9, 12)
+    n_new = (10, 9, 8)
+    prompts = [gen.integers(0, 64, n).tolist() for n in lens]
+    refs = [ref_greedy(model, params, p, n)
+            for p, n in zip(prompts, n_new)]
+    reqs = [Request(p, n, speculate=(4 if i % 2 == 0 else 0))
+            for i, (p, n) in enumerate(zip(prompts, n_new))]
+    sched = Scheduler(qengine, harvest_lag=2, draft=NGramDraft())
+    sched.run(reqs)
+    for req, want in zip(reqs, refs):
+        assert req.done and req.tokens == want, \
+            f"rid={req.rid} diverged under int8 weights + int8 pages"
+    assert sched.metrics.summary()["spec_steps"] > 0
+    assert sched.pages.pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_prefix_cache_hit_on_int8_arena(model, params, qengine):
+    """Cross-request prefix caching over int8 pages: scales ride WITH
+    their page through the same table, so a cached page re-enters
+    through the suffix bucket token-identically — receipts: the hit's
+    only prefill call is the SUFFIX bucket, tokens saved exact."""
+    gen = np.random.default_rng(2)
+    prompt = gen.integers(0, 64, 16).tolist()   # 2 full pages, cap -> 1
+    ref = ref_greedy(model, params, prompt, 5)
+    sched = Scheduler(qengine, harvest_lag=2)
+    r1 = Request(prompt, 5)
+    sched.run([r1])
+    assert r1.tokens == ref
+    before = dict(qengine.prefill_calls)
+    r2 = Request(prompt, 5)
+    sched.run([r2])
+    assert r2.tokens == ref, "int8 cached pages corrupted the suffix"
+    delta = {T: n - before.get(T, 0)
+             for T, n in qengine.prefill_calls.items()
+             if n - before.get(T, 0)}
+    assert delta == {8: 1}, delta
+    s = sched.metrics.summary()
+    assert s["prefill_tokens_saved"] == PAGE
+    assert s["prefix_hit_rate"] > 0
+
+
+@pytest.mark.slow
+def test_dense_w8kv8_engine_token_identity(model, params):
+    """The dense int8 arena (per-slot [B,H,max_seq] buffers + scale
+    rows): w8+kv8 greedy mixed-length traffic with slot reuse == the
+    f32 solo decodes."""
+    eng = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                          quantize_weights=True, kv_dtype="int8")
+    gen = np.random.default_rng(1)
+    lens = (3, 9, 14, 5)
+    n_new = (6, 4, 8, 3)
+    prompts = [gen.integers(0, 64, n).tolist() for n in lens]
+    reqs = [Request(p, n) for p, n in zip(prompts, n_new)]
+    Scheduler(eng, harvest_lag=3).run(reqs)
+    for req, prompt, n in zip(reqs, prompts, n_new):
+        assert req.done
+        assert req.tokens == ref_greedy(model, params, prompt, n), \
+            f"rid={req.rid} diverged on the dense int8 arena"
+    arena = eng.init_arena()
+    assert arena["block_0"]["attn"]["key"].dtype == jnp.int8
+    assert arena["block_0"]["attn"]["key_scale"].shape == (2, 2, MAX_SEQ)
+
+
+@pytest.mark.slow
+def test_engine_logits_parity_w8_and_w8kv8_vs_f32(model, params, qengine):
+    """Engine-level logits parity: prefill of the same probe prompt on
+    the f32 engine, a w8 (f32 KV) engine, and the shared w8+kv8 paged
+    engine all agree within the stated tolerance."""
+    gen = np.random.default_rng(11)
+    prompt = gen.integers(0, 64, 7).tolist()
+    sp = SampleParams()          # greedy
+
+    def first_logits(eng):
+        kw = {}
+        if eng.paged:
+            row = np.zeros(eng.n_ptab, np.int32)
+            row[:2] = [eng.n_pages - 2, eng.n_pages - 1]
+            kw = dict(page_row=row)
+        _, _, logits = eng.prefill(eng.init_arena(),
+                                   eng.init_last_tokens(), 0, prompt,
+                                   sp, **kw)
+        return np.asarray(logits)
+
+    lf = first_logits(InferenceEngine(model, params, n_slots=2,
+                                      buckets=BUCKETS))
+    lw8 = first_logits(InferenceEngine(model, params, n_slots=2,
+                                       buckets=BUCKETS,
+                                       quantize_weights=True))
+    lq = first_logits(qengine)
+    tol = REL_TOL * float(np.abs(lf).max())
+    assert float(np.abs(lw8 - lf).max()) <= tol
+    assert float(np.abs(lq - lf).max()) <= tol
+    assert lw8.argmax() == lf.argmax() == lq.argmax()
+
+
+@pytest.mark.slow
+def test_three_program_families_zero_recompiles(qengine, obs):
+    """Cumulative over every dispatch above: one prefill per touched
+    bucket, ONE decode, one verify per touched k-bucket — int8 weights
+    and the int8 arena are params + layout, never a compile shape —
+    and the policy='raise' sentinel saw zero genuine retraces."""
+    stats = qengine.compile_stats()
+    assert stats["decode"] == 1, stats
+    assert stats["prefill"] and \
+        all(n == 1 for n in stats["prefill"].values()), stats
+    assert all(n == 1 for n in stats["verify"].values()), stats
+    assert stats["quant"]["weights"] and \
+        stats["quant"]["kv_dtype"] == "int8"
+    assert obs.sentinel.summary()["recompile_events"] == 0
+
+
+@pytest.mark.slow
+def test_megatron_4d_snapshot_serves_quantized_paged(devices):
+    """The PR-6 known-remaining: megatron.serve_engine threads paged +
+    quant geometry to the engine, so a 4D training snapshot serves int8
+    weights over an int8 paged arena on the training mesh — smoke:
+    greedy tokens == the bridged quantized model's solo eager decode."""
+    from dtdl_tpu.parallel import megatron as M
+    from test_megatron import _cfg   # tests/ is on sys.path (pytest)
+
+    cfg = _cfg(dtype=jnp.float32)
+    mesh = M.build_4d_mesh(devices)
+    params_host = M.init_params(cfg, jax.random.PRNGKey(17))
+    engine = M.serve_engine(cfg, params_host, mesh=mesh, n_slots=2,
+                            buckets=(8,), page_size=PAGE,
+                            quantize_weights=True, kv_dtype="int8")
+    assert engine.paged and engine.quantized_weights
+    assert engine.kv_dtype == jnp.int8
+    gen = np.random.default_rng(18)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (3, 7)]
+    reqs = [Request(p, 4) for p in prompts]
+    Scheduler(engine, harvest_lag=2).run(reqs)
+    # oracle = the engine's OWN (quantized) model solo eager decode:
+    # pins the paged int8 serve mechanics, not quantization noise
+    for req, prompt in zip(reqs, prompts):
+        assert req.done
+        assert req.tokens == ref_greedy(engine.model, engine.params,
+                                        prompt, 4)
