@@ -408,6 +408,119 @@ def bench_robustness(steps: int = 48, batch_size: int = 256,
             **guard.summary()}
 
 
+def bench_kernels(head_dims=(64, 128), seqs=(4096,), iters: int = 2,
+                  warmup: int = 1, vocabs=(32768, 256),
+                  samp_batch: int = 8, samp_iters: int = 20) -> dict:
+    """Kernel-round microbench (round 13): old vs new hot-path kernels.
+
+    **Attention** — fwd+bwd flash attention at B=1/H=1, bf16, causal,
+    per (head_dim, seq): the round-12 configuration (standalone
+    ``apply_rope`` + the old hardcoded 1024×1024 blocks) against the
+    round-13 one (rope fused into the kernels + autotune-table blocks).
+    Throughput is USEFUL FLOPs (the goodput convention: causal at the
+    computed half, backward at 2x forward, recompute and rope never
+    credited) so old and new divide identical numerators.
+
+    **Sampling** — the serve decode epilogue per vocab size: scale +
+    top-k + top-p filter + categorical draw over [B, V] logits, sorted
+    (descending argsort + cumsum + inverse argsort — the round-12 path,
+    kept as ``filter_logits_sorted``) vs sortless (32-round threshold
+    bisection — ``filter_logits``).
+
+    Honesty: on CPU the attention kernels run under the Pallas
+    interpreter (``interpret: true`` in the row) — block geometry and
+    arithmetic are exactly the TPU program, but relative timings mix in
+    interpreter overheads, and the rope-fusion HBM win by construction
+    cannot show up where there is no HBM (goodput.lm_rope_hbm_bytes
+    carries the bytes arithmetic; LM_ROOFLINE.md the expected v5e
+    effect).  Default seqs stay short for the same reason — pass
+    ``--kernel-seqs 4096,32768`` on a real chip.
+    """
+    from dtdl_tpu.obs.goodput import lm_rope_hbm_bytes
+    from dtdl_tpu.ops.attention import flash_attention, resolve_blocks
+    from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
+    from dtdl_tpu.serve.sampling import filter_logits, filter_logits_sorted
+
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+
+    def timed(fn, *args):
+        fn_j = jax.jit(fn)
+        for _ in range(warmup):
+            out = fn_j(*args)
+        float(jax.tree.leaves(out)[0].ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn_j(*args)
+        float(jax.tree.leaves(out)[0].ravel()[0])
+        return (time.perf_counter() - t0) / iters
+
+    attn = []
+    for d in head_dims:
+        cos, sin = rope_frequencies(d, max(seqs))
+        for s in seqs:
+            q, k, v = (jnp.asarray(rng.normal(size=(1, 1, s, d)),
+                                   jnp.bfloat16) for _ in range(3))
+
+            def loss_old(q, k, v):
+                qr = apply_rope(q, cos[:s], sin[:s])
+                kr = apply_rope(k, cos[:s], sin[:s])
+                o = flash_attention(qr, kr, v, causal=True,
+                                    block_q=1024, block_k=1024)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            def loss_new(q, k, v):
+                o = flash_attention(q, k, v, causal=True,
+                                    rope=(cos, sin))
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            old_s = timed(jax.grad(loss_old, (0, 1, 2)), q, k, v)
+            new_s = timed(jax.grad(loss_new, (0, 1, 2)), q, k, v)
+            useful = 3 * 2 * 1 * 1 * float(s) * float(s) * d  # fwd+2x bwd
+            attn.append({
+                "head_dim": d, "seq": s,
+                "blocks": list(resolve_blocks(d, s)),
+                "old_ms": round(old_s * 1e3, 2),
+                "new_ms": round(new_s * 1e3, 2),
+                "old_tflops": round(useful / old_s / 1e12, 4),
+                "new_tflops": round(useful / new_s / 1e12, 4),
+                "speedup": round(old_s / new_s, 3),
+                # the HBM traffic the fusion removes at THIS geometry
+                # (one layer, B=1/H=1) — the quantity that, not the CPU
+                # ms, is the v5e claim (LM_ROOFLINE.md round 13)
+                "rope_bytes_saved": int(lm_rope_hbm_bytes(
+                    type("C", (), {"n_layers": 1, "n_heads": 1,
+                                   "head_dim": d})(), 1, s)),
+            })
+
+    samp = []
+    for v_sz in vocabs:
+        logits = jnp.asarray(rng.normal(size=(samp_batch, v_sz)) * 3,
+                             jnp.float32)
+        temp = jnp.full((samp_batch,), 0.8, jnp.float32)
+        top_k = jnp.full((samp_batch,), 50, jnp.int32)
+        top_p = jnp.full((samp_batch,), 0.9, jnp.float32)
+        key = jax.random.PRNGKey(0)
+
+        def draw(filt):
+            def fn(lg):
+                masked = filt(lg, temp, top_k, top_p)
+                return jax.random.categorical(key, masked, axis=-1)
+            return fn
+
+        sort_s = timed(draw(filter_logits_sorted), logits)
+        less_s = timed(draw(filter_logits), logits)
+        samp.append({
+            "vocab": v_sz, "batch": samp_batch,
+            "sorted_us": round(sort_s * 1e6, 1),
+            "sortless_us": round(less_s * 1e6, 1),
+            "speedup": round(sort_s / less_s, 3),
+        })
+
+    return {"model": "kernels", "interpret": interpret,
+            "iters": iters, "attention": attn, "sampling": samp}
+
+
 def bench_serving(size: str = None, slot_sweep=(1, 4, 8),
                   new_tokens: int = 32) -> dict:
     """Serving throughput: prefill vs decode tokens/sec vs batch size.
@@ -981,6 +1094,16 @@ def main(argv=None) -> dict:
     p.add_argument("--serve-size", default=None,
                    help="LM size for the serving row (default: tiny on "
                         "CPU, base on an accelerator)")
+    p.add_argument("--skip-kernels", action="store_true",
+                   help="skip the kernel microbench row (attention "
+                        "old-vs-new fwd+bwd + sort vs sortless sampling)")
+    p.add_argument("--kernel-seqs", default="4096",
+                   help="comma-separated attention seq lengths for the "
+                        "kernels row (default 4096; pass 4096,32768 on "
+                        "a real TPU — 32k under the CPU interpreter "
+                        "takes minutes per iteration)")
+    p.add_argument("--kernel-iters", type=int, default=2,
+                   help="timed iterations per kernels-row config")
     a = p.parse_args(argv)
 
     if a.quick:
@@ -1074,6 +1197,21 @@ def main(argv=None) -> dict:
         records.append(resil_row)
         print("  " + json.dumps(resil_row), file=sys.stderr, flush=True)
 
+    kern_row = None
+    if not a.skip_kernels:
+        # kernel-round receipt: attention fwd+bwd old (unfused rope,
+        # hardcoded blocks) vs new (fused rope, autotune table) + the
+        # decode sampling epilogue sorted vs sortless (ISSUE 8)
+        try:
+            kern_row = bench_kernels(
+                seqs=tuple(int(s) for s in a.kernel_seqs.split(",")),
+                iters=a.kernel_iters)
+        except Exception as e:  # the kernels row must never sink the bench
+            kern_row = {"model": "kernels",
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(kern_row)
+        print("  " + json.dumps(kern_row), file=sys.stderr, flush=True)
+
     serve_row = None
     if not a.skip_serving:
         # serving row: prefill vs decode tokens/sec vs batch size — the
@@ -1153,6 +1291,19 @@ def main(argv=None) -> dict:
         summary["observability_overhead_frac"] = obs_row["overhead_frac"]
     if resil_row and "overhead_frac" in resil_row:
         summary["robustness_overhead_frac"] = resil_row["overhead_frac"]
+    if kern_row and kern_row.get("attention"):
+        # kernel receipt: the largest-seq head_dim-128 entry is the one
+        # the roofline story hangs on; fall back to whatever ran
+        ka = kern_row["attention"]
+        best_a = max(ka, key=lambda e: (e["head_dim"] == 128, e["seq"]))
+        summary["kernel_attn_speedup"] = best_a["speedup"]
+        summary["kernel_attn_tflops"] = best_a["new_tflops"]
+        summary["kernel_attn_seq"] = best_a["seq"]
+    if kern_row and kern_row.get("sampling"):
+        ks = max(kern_row["sampling"], key=lambda e: e["vocab"])
+        summary["sampling_sortless_speedup"] = ks["speedup"]
+        summary["sampling_sortless_us"] = ks["sortless_us"]
+        summary["sampling_vocab"] = ks["vocab"]
     if serve_row and serve_row.get("sweep"):
         best_d = max(serve_row["sweep"],
                      key=lambda s: s["decode_tokens_per_sec"])

@@ -58,6 +58,27 @@ def trace_annotation(name: str):
     return _TraceAnnotation(name)
 
 
+def tpu_compiler_params(**kwargs):
+    """Mosaic compiler params under whichever class name this jax ships
+    (``pltpu.CompilerParams`` on current jax, ``pltpu.TPUCompilerParams``
+    on 0.4.x), or ``None`` when neither exists / a param is unknown — so
+    kernel call sites keep one spelling and simply omit the kwarg when
+    the hint is unavailable (it is a scheduling hint, never semantics:
+    the interpreter ignores it and Mosaic only uses it to pipeline)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:      # pragma: no cover - pallas-less jax build
+        return None
+    cls = (getattr(pltpu, "CompilerParams", None)
+           or getattr(pltpu, "TPUCompilerParams", None))
+    if cls is None:        # pragma: no cover - pallas-less jax build
+        return None
+    try:
+        return cls(**kwargs)
+    except TypeError:      # pragma: no cover - param renamed upstream
+        return None
+
+
 class _AvalView:
     """Proxy of an abstract value that answers ``.vma`` on legacy jax."""
 

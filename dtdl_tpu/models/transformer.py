@@ -128,13 +128,16 @@ class Attention(nn.Module):
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         if decode:
             o = self._decode_attend(q, k, v, cos, sin)
+        elif self.attn_impl == "flash":
+            # fused rope (round 13): the rotation rides the kernel's Q/K
+            # tile loads instead of round-tripping [B, H, S, D] through
+            # HBM per layer (ops/attention.py); block shapes resolve from
+            # the static autotune table keyed on (head_dim, seq, causal)
+            o = flash_attention(q, k, v, causal=True, rope=(cos, sin))
         else:
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-            if self.attn_impl == "flash":
-                o = flash_attention(q, k, v, causal=True)
-            else:
-                o = mha_reference(q, k, v, causal=True).astype(self.dtype)
+            o = mha_reference(q, k, v, causal=True).astype(self.dtype)
         o = o.transpose(0, 2, 1, 3)
         if self.quantize:
             return QuantDenseGeneral(
@@ -464,46 +467,52 @@ class Attention(nn.Module):
         q = rope_row(q, pos_safe)
         k = rope_row(k, pos_safe)
 
-        # scatter through the table: token t of row b sits at global
-        # position g = pos[b]+t -> offset g%page of page table[b, g//page]
+        # (page, offset) scatter coordinates for the S new tokens,
+        # computed ONCE per step and shared by every pool leaf — K, V
+        # and (int8) their scale siblings (the PR 6 known-remaining:
+        # the old path flattened/unflattened the ENTIRE pool around
+        # every leaf's scatter — two full-pool transposes per leaf per
+        # decode step; scattering straight onto the (page, offset) axes
+        # leaves the pool layout untouched, and the gather stays
+        # page-granular so XLA moves contiguous [H, page, D] chunks).
+        # Token t of row b lands at offset g%page of physical page
+        # table[b, g//page]; inactive rows route to garbage page 0.
         g = pos_safe[:, None] + jnp.arange(s_new)[None, :]       # [B, S]
         phys = jnp.take_along_axis(
             table, jnp.clip(g // page, 0, n_ptab - 1), axis=1)   # [B, S]
-        flat = phys * page + g % page
-        flat = jnp.where(active[:, None], flat, g % page)  # -> garbage pg
+        page_idx = jnp.where(active[:, None], phys, 0).reshape(-1)
+        off_idx = (g % page).reshape(-1)
 
-        def scatter(pool, new):      # pool [P,H,page,D], new [B,H,S,D]
-            fp = pool.transpose(0, 2, 1, 3).reshape(n_pages * page, H, D)
-            upd = new.transpose(0, 2, 1, 3).reshape(b * s_new, H, D)
-            fp = fp.at[flat.reshape(-1)].set(upd.astype(pool.dtype))
-            return fp.reshape(n_pages, page, H, D).transpose(0, 2, 1, 3)
+        def update_and_view(pool, new):
+            """Scatter ``new`` [B,H,S,...] onto the shared (page_idx,
+            off_idx) coordinates and gather the [B,H,n_ptab*page,...]
+            logical view; returns (pool', view)."""
+            if pool.ndim == 4:
+                upd = new.transpose(0, 2, 1, 3).reshape(b * s_new, H, D)
+                pool = pool.at[page_idx, :, off_idx, :].set(
+                    upd.astype(pool.dtype))
+                pages = jnp.take(pool, table, axis=0)
+                gat = pages.transpose(0, 2, 1, 3, 4).reshape(
+                    b, H, n_ptab * page, D)
+            else:
+                upd = new.transpose(0, 2, 1).reshape(b * s_new, H)
+                pool = pool.at[page_idx, :, off_idx].set(upd)
+                pages = jnp.take(pool, table, axis=0)
+                gat = pages.transpose(0, 2, 1, 3).reshape(
+                    b, H, n_ptab * page)
+            return pool, gat
+
         if quant:
-            # quantize-on-scatter through the SAME flat page offsets:
-            # each new position's K/V row is scaled off its own max, so
-            # append-only shared pages never need rescaling
+            # quantize-on-scatter through the SAME (page, offset)
+            # coordinates: each new position's K/V row is scaled off its
+            # own max, so append-only shared pages never need rescaling
             k, ks = kv_quantize(k)
             v, vs = kv_quantize(v)
-
-            def scatter_s(pool, new):    # pool [P,H,page], new [B,H,S]
-                fp = pool.transpose(0, 2, 1).reshape(n_pages * page, H)
-                upd = new.transpose(0, 2, 1).reshape(b * s_new, H)
-                fp = fp.at[flat.reshape(-1)].set(upd)
-                return fp.reshape(n_pages, page, H).transpose(0, 2, 1)
-            pks.value = scatter_s(pks.value, ks)
-            pvs.value = scatter_s(pvs.value, vs)
-        pk.value = scatter(pk.value, k)
-        pv.value = scatter(pv.value, v)
+            pks.value, kss = update_and_view(pks.value, ks)
+            pvs.value, vss = update_and_view(pvs.value, vs)
+        pk.value, keys = update_and_view(pk.value, k)
+        pv.value, values = update_and_view(pv.value, v)
         ci.value = pos + s_new   # engine masks/rolls back, as dense
-
-        # gather row b's logical view [H, n_ptab*page, D] (== max_seq
-        # positions: page_size divides max_seq by construction) and
-        # attend exactly like the dense vector path
-        def view(pool, row):
-            pages = jnp.take(pool, row, axis=0)    # [n_ptab, H, page, D]
-            return pages.transpose(1, 0, 2, 3).reshape(
-                H, n_ptab * page, D)
-        keys = jax.vmap(view, in_axes=(None, 0))(pk.value, table)
-        values = jax.vmap(view, in_axes=(None, 0))(pv.value, table)
 
         scale = 1.0 / math.sqrt(d)
         qpos = pos_safe[:, None] + jnp.arange(s_new)[None, :]    # [B, S]
@@ -512,15 +521,11 @@ class Attention(nn.Module):
         if quant:
             # dequant-on-gather, fused as in the dense paths: int8
             # pages convert inside the einsum read, the key scale (the
-            # same gathered logical view as the pages) multiplies the
-            # [.., K] logits, the value scale folds into the softmax
-            # weights — garbage-page positions carry scale 0 or stale
-            # finite values, masked exactly like their K/V
-            def view_s(pool, row):
-                pages = jnp.take(pool, row, axis=0)   # [n_ptab, H, page]
-                return pages.transpose(1, 0, 2).reshape(H, n_ptab * page)
-            kss = jax.vmap(view_s, in_axes=(None, 0))(pks.value, table)
-            vss = jax.vmap(view_s, in_axes=(None, 0))(pvs.value, table)
+            # same gathered logical view as the pages, through the same
+            # shared offsets) multiplies the [.., K] logits, the value
+            # scale folds into the softmax weights — garbage-page
+            # positions carry scale 0 or stale finite values, masked
+            # exactly like their K/V
             logits = jnp.einsum("bhqd,bhkd->bhqk", q,
                                 keys.astype(self.dtype),
                                 preferred_element_type=jnp.float32)

@@ -12,7 +12,10 @@ roofline continuously instead of in one-off docs.  Three pieces:
   ``cost_analysis()`` cannot see inside Pallas custom-calls and misses
   the flash-attention FLOPs entirely (LM_ROOFLINE.md §1).  The
   convention is matmul-only model FLOPs — causal attention at the
-  computed half, backward at 2x forward, recompute never credited.
+  computed half, backward at 2x forward, recompute never credited, and
+  elementwise work (rope — fused into the kernels since round 13 —
+  norms, activations) never counted (:func:`lm_rope_hbm_bytes` carries
+  the BYTE side of the rope-fusion story instead).
 * **chip peaks** — :func:`peak_flops_per_chip` (public bf16 figures by
   device_kind; None on CPU and unknown chips).
 * :class:`GoodputMeter` — turns (steps, seconds) windows into the
@@ -122,6 +125,27 @@ def lm_verify_flops(cfg, batch: int, context: int, k: int) -> float:
     already counts real tokens, never drafts.
     """
     return (k + 1) * lm_decode_flops(cfg, batch, context)
+
+
+def lm_rope_hbm_bytes(cfg, batch: int, seq: int,
+                      dtype_bytes: int = 2) -> float:
+    """HBM bytes per train step an UNFUSED rope implementation
+    round-trips — the traffic the fused-rope attention kernels
+    (ops/attention.py, round 13) eliminate.
+
+    Per layer, a standalone ``apply_rope`` reads and writes both
+    [B, H, S, D] Q and K tensors once in the forward, and the backward
+    inverse-rotates dQ/dK the same way: 2 phases × 2 tensors × (read +
+    write) = 8 × B·H·S·D·bytes per layer.  Fused, the rotation runs on
+    tiles already in VMEM and only the [S, D]-shaped table rows move —
+    ~1/(2·B·H) of this, counted as zero here.  NOTE the analytic FLOP
+    numerator (:func:`lm_forward_flops`) is matmul-only by convention
+    and never counted rope's elementwise work, so fusing rope changes
+    measured step TIME, not the model-FLOP accounting — mfu rises
+    because the denominator seconds shrink, with no numerator edit.
+    """
+    qk = batch * cfg.n_heads * cfg.head_dim * seq * dtype_bytes
+    return cfg.n_layers * 8.0 * qk
 
 
 # ---------------------------------------------------------------------------

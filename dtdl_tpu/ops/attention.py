@@ -14,8 +14,30 @@ Design (the standard TPU flash decomposition):
 * backward — two kernels with the same tiling: one accumulates ``dq`` over k
   blocks, one accumulates ``dk``/``dv`` over q blocks, both recomputing the
   probability tile from the saved logsumexp (no S×S residual is stored).
-* causal masking skips whole tiles above the diagonal via ``pl.when`` so the
-  MXU only sees tiles that contribute.
+* causal masking skips whole tiles above the diagonal via ``pl.when``, and
+  (round 13) the k/v **index maps clamp** masked iterations to the last
+  useful block — consecutive grid steps that map to the same block elide
+  their DMA, so skipped tiles cost neither MXU time *nor* HBM bandwidth.
+* **fused rope** (round 13): ``flash_attention(..., rope=(cos, sin))`` folds
+  the rotary embedding into the Q/K tile loads.  The unfused path
+  (``ops/rope.py:apply_rope`` before the kernel) reads and writes both
+  [B, H, S, D] tensors through HBM once per layer per direction just to
+  rotate them; fused, the per-position (cos, sin) rows ride the existing
+  HBM→VMEM tile transfer (tables are [S, D] — ~1/(2·B·H) of the tensor
+  traffic) and the rotation is VPU work between the DMA and the matmul.
+  The backward kernels re-rotate the saved UNROTATED q/k tiles on load
+  (recompute, like the probability tiles) and apply the inverse rotation
+  to the accumulated dq/dk at finalize — rope is per-row orthogonal, so
+  its VJP is the same rotation with the angle negated.
+* grid ``dimension_semantics`` mark the two outer axes ``parallel`` and the
+  sequential (scratch-carrying) axis ``arbitrary``, so Mosaic's pipeliner
+  double-buffers the next iteration's K/V tiles against the current tile's
+  matmuls instead of stalling the MXU at the top of each k step.
+* block shapes come from a small **static autotune table** keyed on
+  (head_dim, seq bucket, causal) — see :data:`_BLOCK_TABLE` — derived from
+  the in-repo v5e block sweep (LM_ROOFLINE.md §2: 12%→25% kernel-efficiency
+  swings on block shape alone).  Explicit ``block_q``/``block_k`` args
+  still override (the tests' fixed geometries).
 
 On non-TPU backends (the 8-virtual-device CPU test mesh, SURVEY §4) the same
 kernels run under the Pallas interpreter, so every test exercises the exact
@@ -32,11 +54,24 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from dtdl_tpu import _compat
+from dtdl_tpu.ops.rope import rope_rows as _rope_rows
+
 NEG_INF = -1e30
 
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _pallas_kwargs():
+    """Shared pallas_call extras: the pipelining hint (outer grid axes
+    parallel, the sequential scratch-carrying axis arbitrary) when this
+    jax can express it.  All three kernels use 3D grids with the inner
+    axis sequential, so one spelling serves them all."""
+    cp = _compat.tpu_compiler_params(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return {"compiler_params": cp} if cp is not None else {}
 
 
 def _vma_of(*arrays):
@@ -70,6 +105,32 @@ def _zero_pad_rows(x, block_start, valid_total):
     return jnp.where(rows < valid_total, x, 0.0)
 
 
+# ---------------------------------------------------------------------------
+# fused rope: rotation helpers + per-position table rows
+# ---------------------------------------------------------------------------
+
+def _rot_half(x):
+    """[x1, x2] -> [-x2, x1] on the last (head_dim) axis."""
+    d2 = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
+
+
+def _rotate(x, c, s):
+    """Apply rope to a [rows, d] tile: f32 compute, cast back to x.dtype —
+    operation-for-operation the same arithmetic as ops/rope.py:apply_rope
+    (x1·c − x2·s ‖ x1·s + x2·c), so fused output bits match unfused."""
+    xf = x.astype(jnp.float32)
+    return (xf * c + _rot_half(xf) * s).astype(x.dtype)
+
+
+def _unrotate_f32(g, c, s):
+    """Transpose (= inverse: rope is orthogonal per row) rotation of an
+    f32 gradient tile — rope with the angle negated."""
+    return g * c - _rot_half(g) * s
+
+
+
+
 def mha_reference(q, k, v, *, causal: bool = True, scale: float | None = None):
     """Dense reference attention (numerics oracle for the kernels).
 
@@ -88,12 +149,127 @@ def mha_reference(q, k, v, *, causal: bool = True, scale: float | None = None):
 
 
 # ---------------------------------------------------------------------------
+# block autotune table
+# ---------------------------------------------------------------------------
+
+# seq is bucketed to the next power of two in this range; larger sequences
+# use the 32768 entry (same tiling — block shape is seq-independent past
+# the knee, only the grid grows)
+_SEQ_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+
+
+def _build_block_table():
+    """(head_dim, seq_bucket, causal) -> (block_q, block_k).
+
+    Derived from the in-repo v5e sweep (LM_ROOFLINE.md §2, re-run round
+    13): at head_dim 128 / seq 4096 the 1024×1024 tile is the measured
+    knee (25.4% kernel efficiency vs 12-19% for smaller tiles; 2048-row
+    blocks fail to compile on VMEM), at head_dim 64 the same shape keeps
+    a smaller edge, and below ~4k the grid/DMA overhead of small tiles
+    dominates so a block spanning the whole sequence wins (the round-4
+    "128×128 loses to XLA dense below seq 4k" finding).  Every entry is
+    EXPLICIT so the preset-config receipt test can pin that no model
+    geometry silently falls back; per-geometry retunes edit this table,
+    never call sites.
+    """
+    table = {}
+    for hd in (16, 32, 64, 128):
+        for causal in (False, True):
+            for seq in _SEQ_BUCKETS:
+                table[(hd, seq, causal)] = ((seq, seq) if seq <= 512
+                                            else (1024, 1024))
+    return table
+
+
+_BLOCK_TABLE = _build_block_table()
+_BLOCK_DEFAULT = (1024, 1024)
+
+
+def block_table_entry(head_dim: int, seq: int, causal: bool = True):
+    """The explicit autotune-table entry covering (head_dim, seq, causal),
+    or None if the geometry has no entry (callers then get
+    :data:`_BLOCK_DEFAULT` unless they asked ``strict``)."""
+    bucket = next((b for b in _SEQ_BUCKETS if seq <= b), _SEQ_BUCKETS[-1])
+    return _BLOCK_TABLE.get((int(head_dim), bucket, bool(causal)))
+
+
+def resolve_blocks(head_dim: int, seq_q: int, seq_k: int | None = None, *,
+                   causal: bool = True, strict: bool = False):
+    """(block_q, block_k) for a kernel geometry, from the autotune table.
+
+    ``strict=True`` raises instead of falling back to the default — the
+    preset-config receipt tests use it to pin that every shipped model
+    geometry resolves to an explicit, swept entry.
+    """
+    seq = max(int(seq_q), int(seq_k if seq_k is not None else seq_q))
+    entry = block_table_entry(head_dim, seq, causal)
+    if entry is None:
+        if strict:
+            raise ValueError(
+                f"no explicit attention block-table entry for head_dim="
+                f"{head_dim}, seq={seq}, causal={causal} (buckets: "
+                f"{_SEQ_BUCKETS}; head_dims: "
+                f"{sorted({k[0] for k in _BLOCK_TABLE})})")
+        return _BLOCK_DEFAULT
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# causal DMA-eliding index maps
+# ---------------------------------------------------------------------------
+
+def _kmaps(causal, block_q, block_k, off, lead_b: bool):
+    """Index map for K-side blocks in the fwd/dq grids ``(b, i, j)``.
+
+    Causal: iterations whose whole tile sits above the diagonal clamp to
+    the last contributing k block — Mosaic skips the DMA when the block
+    index repeats, so masked tiles cost no bandwidth (their compute is
+    already skipped by the ``pl.when`` guard).  ``lead_b=False`` builds
+    the same map for the [S, d] rope tables, which have no batch dim.
+    """
+    if not causal:
+        if lead_b:
+            return lambda b, i, j: (b, j, 0)
+        return lambda b, i, j: (j, 0)
+
+    def last_block(i):
+        return jnp.maximum(((i + 1) * block_q + off - 1) // block_k, 0)
+
+    if lead_b:
+        return lambda b, i, j: (b, jnp.minimum(j, last_block(i)), 0)
+    return lambda b, i, j: (jnp.minimum(j, last_block(i)), 0)
+
+
+def _qmaps(causal, block_q, block_k, off, nq, lead_b: bool):
+    """Index map for Q-side blocks in the dkv grid ``(b, j, i)``: the
+    masked iterations sit at the START of the q loop, so they clamp
+    forward to the first contributing q block (which the pipeline then
+    prefetches during the dead iterations instead of refetching it)."""
+    if not causal:
+        if lead_b:
+            return lambda b, j, i: (b, i, 0)
+        return lambda b, j, i: (i, 0)
+
+    def clamp(i, j):
+        first = jnp.maximum((j * block_k - off) // block_q, 0)
+        return jnp.minimum(jnp.maximum(i, first), nq - 1)
+
+    if lead_b:
+        return lambda b, j, i: (b, clamp(i, j), 0)
+    return lambda b, j, i: (clamp(i, j), 0)
+
+
+# ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                seq_k, off):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
+                seq_k, off, rope):
+    if rope:
+        (qc_ref, qs_ref, kc_ref, ks_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr, qrot_scr) = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -102,6 +278,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
+        if rope:
+            # the q tile is the same for every k step: rotate ONCE into
+            # scratch (each k tile is fresh data, so rotating it per
+            # step is already once per loaded tile)
+            qrot_scr[:] = _rotate(q_ref[0], qc_ref[:], qs_ref[:])
 
     # tiles strictly above the (bottom-aligned) diagonal contribute nothing
     guard = (ki * block_k < (qi + 1) * block_q + off) if causal else (ki >= 0)
@@ -112,6 +293,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # 2x f32 throughput); preferred_element_type gives f32 accumulation
         q = q_ref[0]                              # [bq, d]
         k = k_ref[0]                              # [bk, d]
+        if rope:
+            # rotation rides the tile load: f32 compute, cast back to the
+            # native dtype — bitwise what apply_rope-then-kernel produces
+            q = qrot_scr[:]
+            k = _rotate(k, kc_ref[:], ks_ref[:])
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk] f32
@@ -151,23 +337,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = (m_scr[:] + jnp.log(l_safe)).reshape(1, -1)
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, tabs, scale, causal, block_q, block_k):
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    rope = tabs is not None
     grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=sk, off=sk - sq)
+        block_q=block_q, block_k=block_k, seq_k=sk, off=sk - sq, rope=rope)
+    kmap = _kmaps(causal, block_q, block_k, sk - sq, lead_b=True)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kmap),
+        pl.BlockSpec((1, block_k, d), kmap),
+    ]
+    operands = (q, k, v)
+    if rope:
+        tmap = _kmaps(causal, block_q, block_k, sk - sq, lead_b=False)
+        in_specs += [
+            pl.BlockSpec((block_q, d), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((block_q, d), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((block_k, d), tmap),
+            pl.BlockSpec((block_k, d), tmap),
+        ]
+        operands += tabs
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -176,18 +375,24 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             _sds((bh, sq, d), q.dtype, _vma_of(q, k, v)),
             _sds((bh, 1, sq), jnp.float32, _vma_of(q, k, v)),
         ],
-        scratch_shapes=_scratch(block_q, d),
+        scratch_shapes=(_scratch(block_q, d)
+                        + ([_vmem((block_q, d), q.dtype)] if rope else [])),
         interpret=_use_interpret(),
-    )(q, k, v)
+        **_pallas_kwargs(),
+    )(*operands)
     return o, lse
 
 
-def _scratch(block_q, d):
+def _vmem(shape, dtype):
     from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, dtype)
+
+
+def _scratch(block_q, d):
     return [
-        pltpu.VMEM((block_q, 1), jnp.float32),
-        pltpu.VMEM((block_q, 1), jnp.float32),
-        pltpu.VMEM((block_q, d), jnp.float32),
+        _vmem((block_q, 1), jnp.float32),
+        _vmem((block_q, 1), jnp.float32),
+        _vmem((block_q, d), jnp.float32),
     ]
 
 
@@ -195,14 +400,22 @@ def _scratch(block_q, d):
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, seq_k, off):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, block_q, block_k, seq_k, off, rope):
+    if rope:
+        (qc_ref, qs_ref, kc_ref, ks_ref,
+         dq_ref, dq_scr, qrot_scr) = rest
+    else:
+        dq_ref, dq_scr = rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
+        if rope:
+            # same once-per-q-tile rotation as the forward kernel
+            qrot_scr[:] = _rotate(q_ref[0], qc_ref[:], qs_ref[:])
 
     guard = (ki * block_k < (qi + 1) * block_q + off) if causal else (ki >= 0)
 
@@ -213,6 +426,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
+        if rope:
+            # recompute the rotation on tile load (like the probability
+            # tiles): the residuals stay unrotated
+            q = qrot_scr[:]
+            k = _rotate(k, kc_ref[:], ks_ref[:])
         lse = lse_ref[0].reshape(block_q, 1)      # [bq, 1]
         delta = delta_ref[0].reshape(block_q, 1)  # [bq, 1]
 
@@ -240,12 +458,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dq = dq_scr[:]
+        if rope:
+            # the accumulated grad is w.r.t. the ROTATED q; rope is
+            # orthogonal per row, so its VJP is the inverse rotation —
+            # applied once to the f32 accumulator, then cast
+            dq = _unrotate_f32(dq, qc_ref[:], qs_ref[:])
+        dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k, seq_k, seq_q, off):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, block_q, block_k, seq_k, seq_q, off, rope):
+    if rope:
+        (qc_ref, qs_ref, kc_ref, ks_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr, krot_scr) = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -253,6 +481,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
+        if rope:
+            # this grid holds the K tile fixed and walks q blocks, so
+            # here it is K that rotates once into scratch
+            krot_scr[:] = _rotate(k_ref[0], kc_ref[:], ks_ref[:])
 
     guard = ((qi + 1) * block_q + off > ki * block_k) if causal else (qi >= 0)
 
@@ -263,6 +495,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
+        if rope:
+            q = _rotate(q, qc_ref[:], qs_ref[:])
+            k = krot_scr[:]
         lse = lse_ref[0].reshape(block_q, 1)      # f32 (fwd out_shape)
         delta = delta_ref[0].reshape(block_q, 1)  # f32 (computed in _bwd)
         if seq_q % block_q:
@@ -296,54 +531,90 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dk = dk_scr[:]
+        if rope:
+            dk = _unrotate_f32(dk, kc_ref[:], ks_ref[:])
+        dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do_4d):
+def _bwd(scale, causal, block_q, block_k, res, do_4d, tabs=None):
     q, k, v, o, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    rope = tabs is not None
     do = do_4d
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]          # [bh, 1, sq]
 
+    kmap = _kmaps(causal, block_q, block_k, sk - sq, lead_b=True)
     grid_dq = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    in_specs_dq = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kmap),
+        pl.BlockSpec((1, block_k, d), kmap),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+    ]
+    operands = (q, k, v, do, lse, delta)
+    if rope:
+        tmap = _kmaps(causal, block_q, block_k, sk - sq, lead_b=False)
+        in_specs_dq += [
+            pl.BlockSpec((block_q, d), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((block_q, d), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((block_k, d), tmap),
+            pl.BlockSpec((block_k, d), tmap),
+        ]
+        operands += tabs
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_k=sk,
-                          off=sk - sq),
+                          off=sk - sq, rope=rope),
         grid=grid_dq,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=in_specs_dq,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds((bh, sq, d), q.dtype, _vma_of(q, k, v, do)),
-        scratch_shapes=[_scratch(block_q, d)[2]],
+        scratch_shapes=([_scratch(block_q, d)[2]]
+                        + ([_vmem((block_q, d), q.dtype)] if rope else [])),
         interpret=_use_interpret(),
-    )(q, k, v, do, lse, delta)
+        **_pallas_kwargs(),
+    )(*operands)
 
-    grid_dkv = (bh, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q))
+    nq = pl.cdiv(sq, block_q)
+    qmap = _qmaps(causal, block_q, block_k, sk - sq, nq, lead_b=True)
+    qmap_s = _qmaps(causal, block_q, block_k, sk - sq, nq, lead_b=False)
+
+    def _lse_map(b, j, i):
+        bi, ii, _ = qmap(b, j, i)
+        return (bi, 0, ii)
+
+    grid_dkv = (bh, pl.cdiv(sk, block_k), nq)
+    in_specs_dkv = [
+        pl.BlockSpec((1, block_q, d), qmap),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), qmap),
+        pl.BlockSpec((1, 1, block_q), _lse_map),
+        pl.BlockSpec((1, 1, block_q), _lse_map),
+    ]
+    operands = (q, k, v, do, lse, delta)
+    if rope:
+        in_specs_dkv += [
+            pl.BlockSpec((block_q, d), qmap_s),
+            pl.BlockSpec((block_q, d), qmap_s),
+            pl.BlockSpec((block_k, d), lambda b, j, i: (j, 0)),
+            pl.BlockSpec((block_k, d), lambda b, j, i: (j, 0)),
+        ]
+        operands += tabs
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_k=sk,
-                          seq_q=sq, off=sk - sq),
+                          seq_q=sq, off=sk - sq, rope=rope),
         grid=grid_dkv,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-        ],
+        in_specs=in_specs_dkv,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -352,26 +623,26 @@ def _bwd(scale, causal, block_q, block_k, res, do_4d):
             _sds((bh, sk, d), k.dtype, _vma_of(q, k, v, do)),
             _sds((bh, sk, d), v.dtype, _vma_of(q, k, v, do)),
         ],
-        scratch_shapes=[
-            _scratch(block_k, d)[2], _scratch(block_k, d)[2],
-        ],
+        scratch_shapes=([_scratch(block_k, d)[2], _scratch(block_k, d)[2]]
+                        + ([_vmem((block_k, d), k.dtype)] if rope else [])),
         interpret=_use_interpret(),
-    )(q, k, v, do, lse, delta)
+        **_pallas_kwargs(),
+    )(*operands)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
-# public op with custom VJP
+# public ops with custom VJP (plain + fused-rope variant)
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    o, _ = _fwd(q, k, v, None, scale, causal, block_q, block_k)
     return o
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    o, lse = _fwd(q, k, v, None, scale, causal, block_q, block_k)
     return o, (q, k, v, o, lse)
 
 
@@ -380,6 +651,33 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flash_rope(q, k, v, qc, qs, kc, ks, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, (qc, qs, kc, ks), scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_rope_fwd(q, k, v, qc, qs, kc, ks, scale, causal, block_q,
+                    block_k):
+    o, lse = _fwd(q, k, v, (qc, qs, kc, ks), scale, causal, block_q, block_k)
+    # residuals keep q/k UNROTATED — the backward kernels re-rotate on
+    # tile load, so the rotation never round-trips HBM
+    return o, (q, k, v, o, lse, qc, qs, kc, ks)
+
+
+def _flash_rope_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse, qc, qs, kc, ks = res
+    dq, dk, dv = _bwd(scale, causal, block_q, block_k, (q, k, v, o, lse),
+                      do, tabs=(qc, qs, kc, ks))
+    # rope tables come from rope_frequencies (position constants, never
+    # trained) — their cotangents are defined as zero
+    return (dq, dk, dv, jnp.zeros_like(qc), jnp.zeros_like(qs),
+            jnp.zeros_like(kc), jnp.zeros_like(ks))
+
+
+_flash_rope.defvjp(_flash_rope_fwd, _flash_rope_bwd)
 
 
 def _legal_block(seq: int, block: int) -> int:
@@ -399,32 +697,66 @@ def _legal_block(seq: int, block: int) -> int:
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
-                    block_q: int = 1024, block_k: int = 1024):
+                    block_q: int | None = None, block_k: int | None = None,
+                    rope=None, rope_positions=None):
     """Flash attention over [batch, heads, seq, head_dim] tensors.
 
     Differentiable (custom VJP, recompute-based backward); O(seq) memory.
     Falls back to the Pallas interpreter off-TPU so CPU tests run the same
     kernel code.
 
-    Default 1024x1024 blocks, from a v5e block sweep at the bench headline
-    geometry (B=8, H=4, D=128, seq 4096, bf16, fwd+bwd): 8.2 ms vs 11.5 ms
-    for the old 512x512 default (1.38x; 50 vs 36 useful TFLOP/s) — bigger
-    tiles amortize the bwd recompute's grid/DMA overhead.  The next size up
-    is past the knee: 1024x2048 is 9.1 ms and 2048-row blocks fail to
-    compile (VMEM).  At D=64/H=8 the sweep gives 1024x1024 a smaller edge
-    (17.0 vs 17.9 ms), so one default serves both geometries; earlier
-    small-block data (128x128 losing to XLA dense below seq 4k from
-    grid/DMA overhead) still holds.  VMEM per step ~= bq*bk*4 (score tile)
-    + bq*d*4 (acc): 4.5 MB at 1024/1024/d=128.
+    ``block_q``/``block_k`` default to the static autotune table
+    (:func:`resolve_blocks`, keyed on head_dim / seq bucket / causal —
+    LM_ROOFLINE.md §2's sweep; explicit args override).  VMEM per grid
+    step ~= bq·bk·4 (score tile) + bq·d·4 (acc) + (bq+bk)·d·8 (rope
+    tables): ~6.5 MB at 1024/1024/d=128 with rope.
+
+    ``rope=(cos, sin)`` — the :func:`dtdl_tpu.ops.rope.rope_frequencies`
+    tables, [max_seq, head_dim//2] — fuses the rotary embedding into the
+    kernels: Q/K rotate on tile load (forward AND backward recompute),
+    and dq/dk are inverse-rotated at finalize, so the separate
+    apply_rope HBM round-trip disappears.  Numerically the rotation is
+    the same f32-compute/native-cast arithmetic as ``apply_rope``.
+    ``rope_positions=(pos_q, pos_k)`` gives each row an explicit global
+    position (sequence-parallel shards, zigzag layouts); the default is
+    k at 0..sk-1 with q bottom-aligned (the self-attention / training
+    case: positions 0..seq-1 for both).
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if block_q is None or block_k is None:
+        auto_q, auto_k = resolve_blocks(d, sq, sk, causal=causal)
+        block_q = block_q if block_q is not None else auto_q
+        block_k = block_k if block_k is not None else auto_k
     block_q = _legal_block(sq, block_q)
     block_k = _legal_block(sk, block_k)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-    o = _flash(qf, kf, vf, scale, causal, block_q, block_k)
+    if rope is None:
+        o = _flash(qf, kf, vf, scale, causal, block_q, block_k)
+    else:
+        cos, sin = rope
+        if rope_positions is None:
+            if max(sq, sk) > cos.shape[0]:
+                # the unfused path failed loudly on a short table (shape
+                # mismatch in apply_rope); a silent take-clamp here would
+                # instead reuse the last row's rotation for every
+                # position past the table — wrong outputs, no error
+                raise ValueError(
+                    f"rope table covers {cos.shape[0]} positions but "
+                    f"seq_q={sq}, seq_k={sk}; build rope_frequencies "
+                    f"with max_seq >= the sequence length")
+            pos_k = jnp.arange(sk)
+            pos_q = jnp.maximum(jnp.arange(sq) + (sk - sq), 0)
+        else:
+            # explicit positions are data (possibly traced) — the caller
+            # owns keeping them inside the table, as with apply_rope
+            pos_q, pos_k = rope_positions
+        qc, qs = _rope_rows(cos, sin, pos_q)
+        kc, ks = _rope_rows(cos, sin, pos_k)
+        o = _flash_rope(qf, kf, vf, qc, qs, kc, ks, scale, causal,
+                        block_q, block_k)
     return o.reshape(b, h, sq, d)

@@ -5,6 +5,21 @@ manual-SPMD megatron step (dtdl_tpu/parallel/megatron.py).  Position-offset
 aware so sequence-parallel shards can rotate their *global* positions
 (device i of a ``seq`` axis passes ``offset = i * seq_local``).
 
+Two consumers, two shapes of the same math:
+
+* :func:`apply_rope` — the eager rotation, used by the decode paths (one
+  or a handful of query rows against a KV cache — the rotation is noise
+  there) and as the numerics oracle.
+* the **fused kernel path** (round 13) — training/eval full-sequence
+  attention passes the raw (cos, sin) tables to
+  ``flash_attention(..., rope=(cos, sin))`` and the rotation happens
+  inside the Pallas kernels on tile load, eliminating apply_rope's
+  per-layer HBM round-trip of the full [B, H, S, D] Q/K tensors.
+  :func:`rope_rows` builds the per-position full-width (D, not D/2)
+  table rows the kernels consume: with cc = [c, c] and ss = [s, s],
+  ``rope(x) = x·cc + rot_half(x)·ss`` where rot_half([x1, x2]) =
+  [-x2, x1] — the identical f32 arithmetic as :func:`apply_rope`.
+
 The reference has no sequence models (SURVEY §5.7); this op exists for the
 framework's first-class long-context capability.
 """
@@ -22,6 +37,19 @@ def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0,
     pos = jnp.arange(max_seq, dtype=jnp.float32)
     angles = jnp.outer(pos, inv_freq)
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def rope_rows(cos, sin, positions):
+    """Per-position rope rows widened to full head_dim for the fused
+    kernels: [len(positions), head_dim] f32 (cc, ss) such that
+    ``x·cc + rot_half(x)·ss`` equals :func:`apply_rope` at those
+    positions.  Tiny ([S, D] vs the [B, H, S, D] tensors), so gathering
+    them outside the kernel costs ~1/(2·B·H) of the traffic the fusion
+    removes."""
+    c = jnp.take(cos, positions, axis=0).astype(jnp.float32)
+    s = jnp.take(sin, positions, axis=0).astype(jnp.float32)
+    return (jnp.concatenate([c, c], axis=-1),
+            jnp.concatenate([s, s], axis=-1))
 
 
 def apply_rope(x, cos, sin, offset=0, positions=None):

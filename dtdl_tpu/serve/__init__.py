@@ -43,6 +43,7 @@ from dtdl_tpu.serve.paged import (  # noqa: F401
     GARBAGE_PAGE, PageAllocator, PagePoolExhaustedError,
 )
 from dtdl_tpu.serve.sampling import (  # noqa: F401
-    GREEDY, SampleParams, accept_resample, filter_logits, sample,
+    GREEDY, SampleParams, accept_resample, filter_logits,
+    filter_logits_sorted, sample,
 )
 from dtdl_tpu.serve.scheduler import Request, Scheduler  # noqa: F401

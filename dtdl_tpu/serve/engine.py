@@ -78,9 +78,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dtdl_tpu.ops.attention import block_table_entry, resolve_blocks
 from dtdl_tpu.quant import canon_kv_dtype, quantize_params, tree_bytes
-from dtdl_tpu.serve.sampling import (SampleParams, accept_resample, pack,
-                                     sample)
+from dtdl_tpu.serve.sampling import (FILTER_IMPL, SampleParams,
+                                     accept_resample, pack, sample)
 
 
 class PromptTooLongError(ValueError):
@@ -439,6 +440,14 @@ class InferenceEngine:
         scheduler state, reported by ServeMetrics; this dict stays
         constant across calls so receipts can be compared.)
 
+        ``kernels`` is the kernel-configuration receipt (round 13):
+        which attention block-table entry the model's (head_dim,
+        max_seq) geometry resolves to — ``explicit`` must be True for
+        every shipped preset (no silent fallback; the autotune table in
+        dtdl_tpu/ops/attention.py is the single source of tile shapes)
+        — and which sampling implementation the decode/verify programs
+        fold in (``sortless`` = the threshold-bisection hot path).
+
         ``quant`` is the BYTE receipt of the quantization layer
         (SCALING.md "Quantized serving arithmetic"): ``param_bytes``
         (what every decode step re-reads), the arena split into K/V
@@ -463,7 +472,20 @@ class InferenceEngine:
             elif name != "index":
                 payload += nbytes
         param_bytes = tree_bytes(self.params)
+        hd = self.model.head_dim
+        entry = block_table_entry(hd, self.max_seq, causal=True)
+        # resolve through the same path the kernels use, so a retuned
+        # table/default shows up here without touching this call site
+        blocks = resolve_blocks(hd, self.max_seq, causal=True)
         return {"prefill": {T: n(f) for T, f in self._prefill_fns.items()},
+                "kernels": {
+                    "attention_blocks": {
+                        "head_dim": hd, "max_seq": self.max_seq,
+                        "block_q": blocks[0], "block_k": blocks[1],
+                        "explicit": entry is not None,
+                    },
+                    "sampling": FILTER_IMPL,
+                },
                 "decode": n(self._decode_fn) if self._decode_fn else 0,
                 "verify": {k: n(f) for k, f in self._verify_fns.items()},
                 "paged": ({"page_size": self.page_size,

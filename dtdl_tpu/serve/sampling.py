@@ -5,20 +5,32 @@ decode program samples a continuously-batched mix of requests — one slot
 greedy, its neighbor at temperature 0.9 with top-p 0.95 — and changing a
 request's sampling config must never recompile the step
 (dtdl_tpu/serve/engine.py compiles exactly one decode program).  That
-rules out the usual static ``k`` of ``lax.top_k``; both truncations are
-implemented against the sorted logits instead (one [B, V] sort serves
-top-k and top-p), which is O(V log V) work per step — noise next to the
-forward pass, and shape-static so XLA fuses it into the decode program.
+rules out the usual static ``k`` of ``lax.top_k``.
+
+The hot path (:func:`filter_logits`, round 13) is **sortless**: both
+truncations reduce to "find a logit threshold", and the threshold is
+found by binary search over the float bit pattern — 32 rounds of a
+vectorized count-above (top-k) / mass-above (top-p) over the [B, V]
+logits, no materialized sort, no [B, V] int permutation tensors.  On
+TPU a 32k-vocab descending argsort is a multi-pass lane-shuffle monster
+(O(V log² V) sorting-network work that XLA cannot fuse into the decode
+program's epilogue), while each bisection round is one streaming
+compare-reduce the VPU eats at bandwidth; the old full-sort
+implementation is kept verbatim as :func:`filter_logits_sorted`, the
+parity oracle tests/test_sampling.py pins the keep-sets against
+(adversarial ties included).
 
 Conventions (one per slot, disabled values make the op an identity):
 
 * ``temperature`` — 0 = greedy argmax of the RAW logits (exactly
   ``jnp.argmax``, the token-identity contract tests/test_serve.py pins
   against one-at-a-time decode); > 0 divides logits before sampling.
-* ``top_k`` — keep the k highest-logit tokens; 0 = disabled.
+* ``top_k`` — keep the k highest-logit tokens; 0 = disabled.  Ties at
+  the k-th value widen the keep set (threshold semantics, both paths).
 * ``top_p`` — nucleus: keep the smallest prefix of the sorted
   distribution whose mass reaches ``top_p`` (the first token always
-  survives); >= 1 = disabled.
+  survives); >= 1 = disabled.  Ties at the boundary value keep the
+  lowest-index tokens first (the stable-sort order of the oracle).
 """
 
 from __future__ import annotations
@@ -27,6 +39,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +63,40 @@ class SampleParams:
 
 GREEDY = SampleParams()
 
+# Which filter implementation sample()/accept_resample() below route
+# through — surfaced verbatim by InferenceEngine.compile_stats()'s
+# kernel receipt.  Lives HERE, beside the routing it describes, so
+# rerouting the hot path (e.g. a parity bisect back to
+# filter_logits_sorted) and the receipt are one edit in one module.
+FILTER_IMPL = "sortless"
+
+
+def _desc_keys(x):
+    """Order-preserving uint32 keys of f32 values: ``a < b`` as floats
+    iff ``key(a) < key(b)`` unsigned.  The standard sign-fold (negative
+    floats bit-flip, positives set the top bit); ``x + 0.0`` first
+    canonicalizes -0.0 to +0.0 so equal values always get equal keys
+    (tie semantics must match float comparison, not bit patterns)."""
+    u = lax.bitcast_convert_type(x + 0.0, jnp.uint32)
+    neg = u >= jnp.uint32(0x80000000)
+    return jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
+
+
+def _desc_threshold(keys, weights, need):
+    """Largest uint32 threshold ``t`` with
+    ``sum(weights[keys >= t]) >= need``, per row — built bit-by-bit from
+    the top (32 rounds, each one vectorized masked-sum over [B, V]; no
+    sort, no permutation tensors).  Assumes the predicate holds at t=0
+    (i.e. ``need <= sum(weights)``); rows violating that come back as 0
+    = keep-everything, which the callers' disabled-gates mask anyway."""
+    def body(i, t):
+        cand = t | (jnp.uint32(0x80000000) >> i)
+        mass = jnp.sum(jnp.where(keys >= cand[:, None], weights, 0.0),
+                       axis=-1)
+        return jnp.where(mass >= need, cand, t)
+    return lax.fori_loop(0, 32, body,
+                         jnp.zeros(keys.shape[0], jnp.uint32))
+
 
 def filter_logits(logits, temperature, top_k, top_p):
     """Scale + truncate [B, V] f32 logits per slot: the masked logits
@@ -59,6 +106,53 @@ def filter_logits(logits, temperature, top_k, top_p):
     (speculative accept/residual draws) so both paths sample the exact
     same distribution — the losslessness of spec decode reduces to this
     sharing.
+
+    SORTLESS (see module docstring): top-k finds the k-th largest logit
+    by threshold bisection (count-above predicate) and keeps everything
+    ``>=`` it — including ties, exactly the oracle's widened keep set.
+    Top-p runs the same bisection with mass-above: the boundary value
+    ``v*`` is the largest with ``mass(logit >= v*) >= top_p``; tokens
+    strictly above v* are all kept (their before-mass is < top_p), and
+    the tokens AT v* keep while ``G + r·p(v*) < top_p`` where G is the
+    mass strictly above and r the count of boundary tokens at lower
+    index — reproducing the oracle's stable-sort tie order.  Boundary
+    rounding caveat: the oracle accumulates before-masses as a cumsum
+    in sorted order while this path computes ``G + r·p`` from masked
+    sums; a keep decision within one f32 ulp of top_p can differ
+    (tests/test_sampling.py pins equality everywhere the comparison has
+    any slack, ties included).  One deliberate divergence: at
+    ``top_p >= 1`` this path is EXACTLY disabled (the documented
+    contract), while the oracle's f32 cumsum can saturate at 1.0 on
+    large vocabs and drop tokens whose probability already rounded to
+    zero — a <= 1e-7 total-variation hair the disabled-gate removes.
+    """
+    _, V = logits.shape
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    keys = _desc_keys(scaled)
+
+    # top-k: bisect for the k-th largest key, keep >= it (ties widen)
+    need_k = jnp.clip(top_k, 1, V).astype(jnp.float32)
+    t_k = _desc_threshold(keys, jnp.ones_like(scaled), need_k)
+    keep_k = jnp.where((top_k > 0)[:, None], keys >= t_k[:, None], True)
+
+    # top-p: bisect for the boundary value over cumulative masked mass
+    probs = jax.nn.softmax(scaled, axis=-1)
+    t_p = _desc_threshold(keys, probs, top_p)
+    gt = keys > t_p[:, None]
+    eq = keys == t_p[:, None]
+    above = jnp.sum(jnp.where(gt, probs, 0.0), axis=-1)      # G [B]
+    rank_eq = jnp.cumsum(eq, axis=-1) - eq                   # r per token
+    keep_p = gt | (eq & (above[:, None] + rank_eq * probs < top_p[:, None]))
+    keep_p = jnp.where((top_p < 1.0)[:, None], keep_p, True)
+
+    return jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+
+
+def filter_logits_sorted(logits, temperature, top_k, top_p):
+    """The original full-sort implementation — O(V log V) descending
+    argsort + cumsum over the sorted copy + inverse argsort per call.
+    Kept verbatim as the PARITY ORACLE for :func:`filter_logits` (the
+    sortless hot path); not used by the serve programs.
     """
     _, V = logits.shape
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]

@@ -7,11 +7,16 @@ import numpy as np
 import pytest
 
 from dtdl_tpu.ops.attention import flash_attention, mha_reference
+from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
 
 
 def _rand(shape, seed=0):
     return jnp.asarray(np.random.default_rng(seed).normal(size=shape),
                        jnp.float32)
+
+
+def _sq_loss(fn):
+    return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
 
 
 def test_legal_block_geometry():
@@ -125,6 +130,170 @@ def test_flash_bf16_forward_and_grads():
         assert a.dtype == jnp.bfloat16
         np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
                                    atol=0.15, rtol=0.15)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_fused_rope_matches_unfused(causal):
+    """rope=(cos, sin) fused into the kernels == apply_rope outside then
+    the plain kernels, fwd AND grads — the round-13 fusion contract.
+    f32 is exact (the in-kernel rotation is the same f32 arithmetic);
+    the grad comparison is against autodiff THROUGH apply_rope, i.e. the
+    fused backward's inverse rotation vs jax's linearized rotation."""
+    d = 32
+    q, k, v = (_rand((2, 2, 256, d), s) for s in range(3))
+    cos, sin = rope_frequencies(d, 512)
+    fused = flash_attention(q, k, v, causal=causal, rope=(cos, sin),
+                            block_q=128, block_k=128)
+    unfused = flash_attention(apply_rope(q, cos, sin),
+                              apply_rope(k, cos, sin), v, causal=causal,
+                              block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                               atol=1e-6, rtol=1e-6)
+    ref = mha_reference(apply_rope(q, cos, sin), apply_rope(k, cos, sin),
+                        v, causal=causal)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               atol=2e-6, rtol=1e-5)
+
+    g_f = jax.grad(_sq_loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, rope=(cos, sin),
+        block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
+    g_u = jax.grad(_sq_loss(lambda q, k, v: flash_attention(
+        apply_rope(q, cos, sin), apply_rope(k, cos, sin), v,
+        causal=causal, block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_u):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_fused_rope_ragged_and_cross():
+    """Odd shapes through the fused path: a ragged 200-row tail tile and
+    a cross-attention 160/320 (q bottom-aligned, the default positions:
+    unfused parity needs apply_rope(q, offset=sk-sq)).  slow: four
+    extra fwd+bwd interpreter compiles; the tier-1 parity pin is
+    test_fused_rope_matches_unfused (870s budget discipline)."""
+    d = 16
+    cos, sin = rope_frequencies(d, 512)
+    for (sq, sk) in ((200, 200), (160, 320)):
+        q = _rand((1, 2, sq, d), 0)
+        k = _rand((1, 2, sk, d), 1)
+        v = _rand((1, 2, sk, d), 2)
+        fused = flash_attention(q, k, v, causal=True, rope=(cos, sin),
+                                block_q=128, block_k=128)
+        qr = apply_rope(q, cos, sin, offset=sk - sq)
+        kr = apply_rope(k, cos, sin)
+        np.testing.assert_allclose(
+            np.asarray(fused),
+            np.asarray(mha_reference(qr, kr, v, causal=True)),
+            atol=2e-6, rtol=1e-5)
+
+        g_f = jax.grad(_sq_loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, rope=(cos, sin),
+            block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
+        g_u = jax.grad(_sq_loss(lambda q, k, v: flash_attention(
+            apply_rope(q, cos, sin, offset=sk - sq),
+            apply_rope(k, cos, sin), v, causal=True,
+            block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
+        for a, b in zip(g_f, g_u):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_fused_rope_explicit_positions():
+    """rope_positions overrides the contiguous default — the sequence-
+    parallel / zigzag hook: parity vs apply_rope(positions=...).
+    slow: two extra interpreter compiles (budget discipline)."""
+    d, s = 16, 256
+    cos, sin = rope_frequencies(d, 512)
+    pos = jnp.asarray(np.random.default_rng(9).permutation(512)[:s],
+                      jnp.int32)
+    q, k, v = (_rand((1, 2, s, d), i) for i in range(3))
+    fused = flash_attention(q, k, v, causal=True, rope=(cos, sin),
+                            rope_positions=(pos, pos),
+                            block_q=128, block_k=128)
+    unfused = flash_attention(apply_rope(q, cos, sin, positions=pos),
+                              apply_rope(k, cos, sin, positions=pos), v,
+                              causal=True, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                               atol=1e-6, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_fused_rope_bf16():
+    """bf16 through the fused kernels: XLA may fold the rotate→cast→dot
+    chain differently than the pre-rotated path (observed: ~0.03% of
+    elements one bf16 ulp apart), so the pin is one-ulp-loose against
+    unfused and standard bf16 tolerance against the f32 reference.
+    slow: fwd+bwd compiles in two dtypes (budget discipline; the bf16
+    kernel path itself stays tier-1-covered via test_transformer's
+    flash-model tests and test_flash_bf16_forward_and_grads)."""
+    d = 32
+    q, k, v = (_rand((2, 2, 256, d), s).astype(jnp.bfloat16)
+               for s in range(3))
+    cos, sin = rope_frequencies(d, 512)
+    fused = flash_attention(q, k, v, causal=True, rope=(cos, sin),
+                            block_q=128, block_k=128)
+    assert fused.dtype == jnp.bfloat16
+    unfused = flash_attention(apply_rope(q, cos, sin),
+                              apply_rope(k, cos, sin), v, causal=True,
+                              block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(fused, np.float32),
+                               np.asarray(unfused, np.float32),
+                               atol=1e-2, rtol=5e-2)
+    ref = mha_reference(
+        apply_rope(q, cos, sin).astype(jnp.float32),
+        apply_rope(k, cos, sin).astype(jnp.float32),
+        v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(fused, np.float32),
+                               np.asarray(ref), atol=5e-2, rtol=5e-2)
+
+    g_f = jax.grad(_sq_loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, rope=(cos, sin),
+        block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
+    g_u = jax.grad(_sq_loss(lambda q, k, v: flash_attention(
+        apply_rope(q, cos, sin), apply_rope(k, cos, sin), v,
+        causal=True, block_q=128, block_k=128)), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_u):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=0.15, rtol=0.15)
+
+
+def test_fused_rope_short_table_raises():
+    """A rope table shorter than the sequence fails LOUDLY (the unfused
+    path's apply_rope shape error) — never a silent take-clamp that
+    would reuse the last row's rotation past the table."""
+    d = 16
+    q = _rand((1, 1, 64, d), 0)
+    cos, sin = rope_frequencies(d, 32)          # table < seq
+    with pytest.raises(ValueError, match="rope table"):
+        flash_attention(q, q, q, causal=True, rope=(cos, sin))
+
+
+def test_block_table_covers_presets():
+    """The autotune-table receipt (ISSUE 8): every shipped model preset
+    resolves to an EXPLICIT block-table entry — no silent fallback —
+    and so do the bench/roofline sweep geometries.  Unknown geometries
+    fall back to the documented default unless strict."""
+    from dtdl_tpu.models.transformer import transformer_lm
+    from dtdl_tpu.ops.attention import (_BLOCK_DEFAULT, block_table_entry,
+                                        resolve_blocks)
+    for size in ("tiny", "small", "base", "large", "base-moe8",
+                 "small-hd128", "base-hd128"):
+        cfg = transformer_lm(size)
+        for causal in (True, False):
+            entry = block_table_entry(cfg.head_dim, cfg.max_seq, causal)
+            assert entry is not None, (size, causal)
+            assert resolve_blocks(cfg.head_dim, cfg.max_seq,
+                                  causal=causal, strict=True) == entry
+    for d in (64, 128):
+        for s in (4096, 32768):
+            assert block_table_entry(d, s, True) is not None
+    assert resolve_blocks(256, 999) == _BLOCK_DEFAULT
+    with pytest.raises(ValueError, match="block-table"):
+        resolve_blocks(256, 999, strict=True)
 
 
 def test_ring_attention_bf16_matches_dense():

@@ -1,0 +1,155 @@
+"""Sortless sampling vs the sort-based oracle (round 13).
+
+``filter_logits`` (the serve decode hot path) finds its top-k / top-p
+thresholds by bisection over the float bit pattern — no materialized
+sort; ``filter_logits_sorted`` is the original full-sort implementation
+kept verbatim as the parity oracle.  The contract: identical keep-sets
+(hence sample-identical draws under a shared PRNG key) everywhere the
+keep decision has any numeric slack — including adversarial ties at both
+truncation boundaries, k=0 / k>V, and mixed per-slot configs.  The one
+documented divergence is top_p >= 1 on vocabs whose f32 cumsum saturates
+at 1.0 (see the filter_logits docstring); tests pin that class on a
+small well-conditioned vocab where both paths agree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtdl_tpu.serve.sampling import (filter_logits, filter_logits_sorted,
+                                     sample)
+
+
+def _both(logits, temp, top_k, top_p):
+    logits = jnp.asarray(logits, jnp.float32)
+    temp = jnp.asarray(temp, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    new = np.asarray(filter_logits(logits, temp, top_k, top_p))
+    ref = np.asarray(filter_logits_sorted(logits, temp, top_k, top_p))
+    return new, ref
+
+
+def _assert_same_keep(new, ref, msg=""):
+    np.testing.assert_array_equal(np.isneginf(new), np.isneginf(ref),
+                                  err_msg=msg)
+    keep = ~np.isneginf(new)
+    np.testing.assert_allclose(new[keep], ref[keep], rtol=1e-6,
+                               err_msg=msg)
+
+
+def test_sortless_matches_oracle_random():
+    """Random logits across mixed per-slot configs (the continuous-
+    batching shape: every row a different knob setting)."""
+    rng = np.random.default_rng(0)
+    for seed in range(3):
+        logits = np.random.default_rng(seed).normal(size=(5, 101)) * 3
+        new, ref = _both(
+            logits,
+            [0.7, 1.0, 0.3, 2.0, 1e-3],
+            [0, 5, 1, 17, 100],
+            [0.9, 0.5, 0.3, 0.99, 0.7])
+        _assert_same_keep(new, ref, f"seed {seed}")
+    del rng
+
+
+def test_topk_tie_widening():
+    """Six tokens tied at the top with k=3: threshold semantics keep ALL
+    six on both paths (ties widen, never an arbitrary sort order)."""
+    logits = np.full((1, 32), -5.0)
+    tied = [3, 7, 11, 19, 23, 30]
+    logits[0, tied] = 2.0
+    new, ref = _both(logits, [1.0], [3], [1.0])
+    _assert_same_keep(new, ref)
+    keep = ~np.isneginf(new[0])
+    assert keep[tied].all() and keep.sum() == len(tied)
+
+
+def test_topp_tie_boundary_stable_order():
+    """Four tokens at exactly p=0.25 with top_p=0.6: the oracle's stable
+    sort keeps the three LOWEST-INDEX tied tokens (before-mass 0, .25,
+    .5 < 0.6; .75 dropped) — the sortless boundary ranking reproduces
+    that index order exactly."""
+    logits = np.zeros((1, 4))
+    new, ref = _both(logits, [1.0], [0], [0.6])
+    _assert_same_keep(new, ref)
+    assert not np.isneginf(new[0, :3]).any()
+    assert np.isneginf(new[0, 3])
+
+
+def test_topp_first_token_always_survives():
+    """top_p smaller than the top token's own mass still keeps it (the
+    smallest-prefix-reaching-top_p rule's floor) on both paths."""
+    logits = np.asarray([[5.0, 0.0, -1.0, -2.0]])
+    new, ref = _both(logits, [1.0], [0], [0.01])
+    _assert_same_keep(new, ref)
+    assert not np.isneginf(new[0, 0])
+    assert np.isneginf(new[0, 1:]).all()
+
+
+def test_disabled_and_overflow_knobs():
+    """k=0 and top_p>=1 disable their truncation; k>V keeps everything.
+    Small vocab + moderate logits so the oracle's top_p=1.0 cumsum stays
+    strictly below 1.0 (the documented saturation caveat class)."""
+    logits = np.random.default_rng(1).normal(size=(3, 16))
+    new, ref = _both(logits, [1.0, 0.5, 2.0], [0, 99, 3], [1.0, 1.5, 0.8])
+    _assert_same_keep(new, ref)
+    # rows 0/1: no truncation at all survives both knobs
+    assert not np.isneginf(new[:2]).any()
+
+
+def test_all_equal_logits():
+    new, ref = _both(np.zeros((2, 16)), [1.0, 0.3], [4, 0], [1.0, 0.5])
+    _assert_same_keep(new, ref)
+
+
+def test_negative_zero_ties():
+    """-0.0 and +0.0 logits are EQUAL values: the bit-pattern keys must
+    not order them apart (the key canonicalization pin)."""
+    logits = np.zeros((1, 8))
+    logits[0, ::2] = -0.0
+    new, ref = _both(logits, [1.0], [3], [0.7])
+    _assert_same_keep(new, ref)
+
+
+def test_sample_identity_shared_key():
+    """sample() routed through the sortless filter draws the SAME token
+    as a manual draw from the oracle-masked logits under a shared key —
+    the spec-decode losslessness contract reduced to one assert."""
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.normal(size=(6, 64)) * 2, jnp.float32)
+    temp = jnp.asarray([0.0, 0.8, 0.8, 1.5, 0.3, 1.0], jnp.float32)
+    top_k = jnp.asarray([0, 10, 0, 5, 3, 0], jnp.int32)
+    top_p = jnp.asarray([1.0, 0.9, 0.5, 0.99, 0.7, 0.8], jnp.float32)
+    for s in range(5):
+        key = jax.random.PRNGKey(s)
+        got = sample(logits, key, temp, top_k, top_p)
+        masked = filter_logits_sorted(logits, temp, top_k, top_p)
+        drawn = jax.random.categorical(key, masked, axis=-1)
+        want = jnp.where(temp <= 0.0,
+                         jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                         drawn.astype(jnp.int32))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_engine_kernels_receipt():
+    """compile_stats()['kernels'] without compiling a single program:
+    the model geometry resolves to an EXPLICIT attention block-table
+    entry and the decode programs fold the sortless sampler (ISSUE 8)."""
+    from dtdl_tpu.models.transformer import transformer_lm
+    from dtdl_tpu.serve.engine import InferenceEngine
+
+    model = transformer_lm("tiny")
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                            jnp.zeros((1, 1), jnp.int32))["params"]
+    eng = InferenceEngine(model, params, n_slots=2)
+    kern = eng.compile_stats()["kernels"]
+    assert kern["sampling"] == "sortless"
+    ab = kern["attention_blocks"]
+    assert ab["explicit"] is True
+    assert ab["head_dim"] == model.head_dim
+    assert ab["max_seq"] == model.max_seq
+    assert ab["block_q"] >= 1 and ab["block_k"] >= 1
+    # no prefill/decode/verify program was ever built for this receipt
+    assert eng.compile_stats()["prefill"] == {}
+    assert eng.compile_stats()["decode"] == 0
