@@ -807,6 +807,87 @@ def bench_quant(model, params, n_slots: int = 4, page_size: int = 32,
     return out
 
 
+def bench_fleet(n_requests: int = 24, new_tokens: int = 24) -> dict:
+    """Fleet row (ISSUE 9): Router throughput at 1 vs 2 replicas, plus
+    a kill-one-replica failover drill.
+
+    Throughput: the same synthetic traffic driven through the Router's
+    least-loaded dispatch over thread-hosted replicas SHARING one
+    engine (XLA executions release the GIL, so two replicas can overlap
+    device work; at tiny scale host dispatch dominates, so treat the
+    ratio as a lower bound — on real HBM-bound decode each replica is
+    its own device and the scaling is near-linear by construction).
+
+    Failover: a loop-site fault kills replica 0's worker mid-traffic.
+    Receipts: ``time_to_evict_s`` (worker death → the EVICTED health
+    transition, i.e. detection latency through the watchdog/probe
+    path), ``requests_retried``, and ``requests_lost`` — which must be
+    ZERO: every accepted request reaches a terminal state, retried ones
+    token-identical by greedy determinism (the fleet invariant,
+    tests/test_fleet.py)."""
+    import flax.linen as nn
+    from dtdl_tpu.models import transformer_lm
+    from dtdl_tpu.resil import FaultPlan
+    from dtdl_tpu.resil.faults import replica_site
+    from dtdl_tpu.serve import InferenceEngine, Request, Router, Scheduler
+
+    model = transformer_lm("tiny", attn_impl="dense", dtype=jnp.float32)
+    params = nn.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    engine = InferenceEngine(model, params, n_slots=4, buckets=(64,))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size,
+                            int(rng.integers(8, 64))).tolist()
+               for _ in range(n_requests)]
+
+    def traffic():
+        return [Request(list(p), new_tokens) for p in prompts]
+
+    # warm the compiled programs outside every timed region
+    Scheduler(engine, harvest_lag=4).run(
+        [Request(list(prompts[0]), 4)])
+
+    row = {"model": "fleet", "n_requests": n_requests,
+           "new_tokens": new_tokens, "replicas": []}
+    for n_rep in (1, 2):
+        with Router(engine, n_replicas=n_rep,
+                    sched_kwargs={"harvest_lag": 4}) as router:
+            t0 = time.perf_counter()
+            router.run(traffic(), timeout_s=600)
+            wall = time.perf_counter() - t0
+            s = router.summary()
+        row["replicas"].append({
+            "n_replicas": n_rep,
+            "wall_s": round(wall, 4),
+            "decode_tokens_per_sec": round(
+                s["fleet_decode_tokens"] / wall, 1) if wall else 0.0,
+            "ttft_s_p50": s.get("fleet_ttft_s_p50", 0.0),
+            "ttft_s_p99": s.get("fleet_ttft_s_p99", 0.0),
+        })
+
+    # the failover drill: kill replica 0's worker on its 4th iteration
+    plan = FaultPlan().at(replica_site(0, "loop"), 3)
+    with Router(engine, n_replicas=2, plan=plan, retry_budget=4,
+                watchdog_s=0.2, probe_interval_s=0.02,
+                sched_kwargs={"harvest_lag": 4}) as router:
+        router.run(traffic(), timeout_s=600)
+        s = router.summary()
+        evict = router.evict_log[0] if router.evict_log else {}
+    lost = (s["fleet_requests_submitted"]
+            - (s["fleet_requests_finished"] + s["fleet_requests_rejected"]
+               + s["fleet_requests_expired"] + s["fleet_requests_failed"]
+               + s["fleet_requests_aborted"]))
+    row["failover"] = {
+        "time_to_evict_s": evict.get("detect_latency_s"),
+        "requests_retried": s["fleet_retries"],
+        "requests_failed": s["fleet_requests_failed"],
+        "requests_lost": lost,
+        "evictions": s["fleet_evictions"],
+        "restarts": s["fleet_restarts"],
+    }
+    return row
+
+
 # ---------------------------------------------------------------------------
 # modeled multi-chip scaling (SCALING.md)
 #
@@ -1085,6 +1166,10 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-serving", action="store_true",
                    help="skip the serving (prefill/decode tokens/sec vs "
                         "batch size) row")
+    p.add_argument("--skip-fleet", action="store_true",
+                   help="skip the serving-fleet row (1 vs 2 replica "
+                        "Router throughput + kill-one-replica failover "
+                        "drill)")
     p.add_argument("--skip-observability", action="store_true",
                    help="skip the observability-overhead (tracer on vs "
                         "off steps/sec) row")
@@ -1223,6 +1308,18 @@ def main(argv=None) -> dict:
                          "error": f"{type(e).__name__}: {e}"[:200]}
         records.append(serve_row)
         print("  " + json.dumps(serve_row), file=sys.stderr, flush=True)
+
+    fleet_row = None
+    if not a.skip_fleet:
+        # fleet row: Router over thread-hosted replicas — 1 vs 2 replica
+        # throughput + the kill-one-replica failover receipts (ISSUE 9)
+        try:
+            fleet_row = bench_fleet()
+        except Exception as e:  # the fleet row must never sink the bench
+            fleet_row = {"model": "fleet",
+                         "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(fleet_row)
+        print("  " + json.dumps(fleet_row), file=sys.stderr, flush=True)
 
     ok = [r for r in records if "samples_per_sec" in r]
     # headline = the best-MFU row of the reference-parity model (pyramidnet),
@@ -1367,6 +1464,24 @@ def main(argv=None) -> dict:
         if f32p and w8kv8p and f32p["n_pages"]:
             summary["serve_quant_paged_capacity_x"] = round(
                 w8kv8p["n_pages"] / f32p["n_pages"], 3)
+    if fleet_row and fleet_row.get("replicas"):
+        # fleet receipt (ISSUE 9): per-replica-count throughput plus
+        # the failover drill — requests_lost MUST report 0
+        by_n = {e["n_replicas"]: e for e in fleet_row["replicas"]}
+        if 1 in by_n:
+            summary["fleet_tokens_per_sec_1r"] = \
+                by_n[1]["decode_tokens_per_sec"]
+        if 2 in by_n:
+            summary["fleet_tokens_per_sec_2r"] = \
+                by_n[2]["decode_tokens_per_sec"]
+        if 1 in by_n and 2 in by_n and by_n[1]["decode_tokens_per_sec"]:
+            summary["fleet_speedup_2r"] = round(
+                by_n[2]["decode_tokens_per_sec"]
+                / by_n[1]["decode_tokens_per_sec"], 3)
+        fo = fleet_row.get("failover") or {}
+        summary["fleet_time_to_evict_s"] = fo.get("time_to_evict_s")
+        summary["fleet_requests_retried"] = fo.get("requests_retried")
+        summary["fleet_requests_lost"] = fo.get("requests_lost")
 
     full = dict(summary)
     full["records"] = records

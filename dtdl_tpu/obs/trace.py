@@ -9,11 +9,16 @@ context managers record complete ('X') events on the calling thread
 (the serve scheduler adds ``admit`` / ``harvest`` and, under
 speculative decoding, ``draft`` — host time inside the DraftSource —
 and ``verify`` — the k-wide verify dispatch, args carrying the step's
-draft width).  The resil layer marks its recoveries as zero-duration
+draft width; the fleet Router adds ``route`` around its dispatch
+round).  The resil layer marks its recoveries as zero-duration
 :meth:`Tracer.instant` events (``guard_bad_step`` / ``guard_rollback``
-/ ``trainer_preempted`` / ``request_expired`` / ``engine_failure`` /
-``scheduler_shutdown``, via ``Observer.event``), so a trace shows
-exactly where a run skipped, rolled back, or shed load.  Everything is
+/ ``trainer_preempted`` / ``request_expired`` / ``request_cancelled``
+/ ``engine_failure`` / ``scheduler_shutdown``, via ``Observer.event``),
+and the fleet layer its health/lifecycle edges (``replica_suspect`` /
+``replica_evicted`` / ``replica_draining`` / ``replica_restarted`` /
+``request_retry`` / ``request_hedged`` / ``hedge_won`` /
+``router_shutdown``), so a trace shows exactly where a run skipped,
+rolled back, shed load, or failed over.  Everything is
 thread-safe for the serve scheduler, exported as Chrome-trace-event JSON
 that Perfetto / ``chrome://tracing`` loads directly — the same format
 the XLA profiler emits, so the two traces read with the same tools

@@ -24,7 +24,7 @@ SCALING.md "Failure model".
 
 from dtdl_tpu.resil.faults import (  # noqa: F401
     Fault, FaultPlan, InjectedCrash, InjectedFault, LoaderFaults, fire,
-    poison_batch,
+    poison_batch, replica_site,
 )
 from dtdl_tpu.resil.guard import (  # noqa: F401
     AnomalousStepError, GuardEscalationError, GuardRollback, StepGuard,

@@ -55,7 +55,35 @@ from typing import Iterable, Optional
 
 import numpy as np
 
-KINDS = ("raise", "crash", "sigterm", "stall", "nan")
+KINDS = ("raise", "crash", "sigterm", "stall", "nan", "blackhole")
+
+# the serving-fleet injection surface (dtdl_tpu/serve/fleet.py): every
+# replica exposes three sites, so every transition of the router's
+# health state machine is deterministically reachable —
+#   engine — fired before each compiled-program dispatch of replica i
+#            ("raise" at occurrence k == the engine dying on exactly its
+#            k-th program call; the Scheduler contains it, the Router
+#            sees the passive containment signal);
+#   loop   — fired once per worker-thread iteration ("raise" kills the
+#            hosting thread = a wedged/dead replica whose heartbeat
+#            stops; "stall" freezes the harvest loop for `seconds`,
+#            tripping the Router's stall watchdog);
+#   probe  — fired on each active health probe of replica i
+#            ("blackhole" = the probe gets no answer, "raise" = the
+#            health endpoint itself crashing; either way the probe
+#            reports failure and the circuit breaker advances).
+REPLICA_POINTS = ("engine", "loop", "probe")
+
+
+def replica_site(idx: int, point: str) -> str:
+    """Canonical fault-site name for serving-fleet replica ``idx`` —
+    one of the three per-replica injection points above.  Central so
+    tests, the Replica host, and FaultPlan schedules can never drift on
+    spelling."""
+    if point not in REPLICA_POINTS:
+        raise ValueError(f"unknown replica fault point {point!r} "
+                         f"(one of {REPLICA_POINTS})")
+    return f"replica{idx}.{point}"
 
 
 class InjectedFault(RuntimeError):
@@ -132,8 +160,10 @@ class FaultPlan:
     def fire(self, site: str) -> Optional[Fault]:
         """Record one occurrence of ``site``; trigger any fault scheduled
         for it.  Control-flow kinds (raise/crash/sigterm/stall) trigger
-        here; data kinds (``nan``) are returned for the caller — e.g.
-        :class:`LoaderFaults` — to apply to its payload."""
+        here; data kinds (``nan``, ``blackhole``) are returned for the
+        caller — e.g. :class:`LoaderFaults` poisons its payload on
+        ``nan``, a fleet Replica's probe reports no-answer on
+        ``blackhole``."""
         i = self._counts[site]
         self._counts[site] += 1
         for f in self.faults:

@@ -25,11 +25,22 @@ prefixes (system prompts) prefill ONCE and are shared read-only:
                              page_size=64, n_pages=256)   # overcommit
     sched = Scheduler(engine)          # prefix_cache=True by default
 
+A **health-checked fleet** of N replicas — least-loaded routing,
+circuit-breaker failure detection, deterministic failover/retry,
+straggler hedging, and rolling restarts — is one more layer up
+(replicas share the engine's compiled programs; greedy retries are
+token-identical by determinism):
+
+    router = Router(engine, n_replicas=2)       # thread-hosted replicas
+    done = router.run([Request(p, 32) for p in prompts])
+    router.shutdown()                           # or `with Router(...)`
+
 See engine.py (the compiled-program contract), scheduler.py (slot-based
 continuous batching + spec integration), paged.py (page allocator +
 radix-style prefix cache), draft.py (draft sources), sampling.py
 (per-slot greedy/temperature/top-k/top-p + the accept/resample kernel),
-metrics.py (async serving telemetry).
+metrics.py (async serving telemetry), health.py (the per-replica state
+machine), fleet.py (the Router/Replica fleet layer).
 """
 
 from dtdl_tpu.serve.draft import (  # noqa: F401
@@ -37,6 +48,12 @@ from dtdl_tpu.serve.draft import (  # noqa: F401
 )
 from dtdl_tpu.serve.engine import (  # noqa: F401
     InferenceEngine, PromptTooLongError, default_buckets,
+)
+from dtdl_tpu.serve.fleet import (  # noqa: F401
+    FleetMetrics, Replica, Router,
+)
+from dtdl_tpu.serve.health import (  # noqa: F401
+    DRAINING, EVICTED, HEALTHY, SUSPECT, ReplicaHealth,
 )
 from dtdl_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from dtdl_tpu.serve.paged import (  # noqa: F401

@@ -1,0 +1,1156 @@
+"""Serving fleet: a health-checked Router over N engine replicas.
+
+Everything PRs 2-8 built — continuous batching, paged KV, quantized
+weights, speculative decoding, containment — lives inside ONE
+Scheduler+InferenceEngine pair in one thread: a single wedged compiled
+step or poisoned arena is a full outage.  This module is the fleet
+layer that turns N such pairs into one service that survives a sick
+replica (ROADMAP item 3; the serving analogue of the paper's
+multi-worker rendezvous-and-recover idiom):
+
+* **admission + dispatch** — :meth:`Router.submit` feeds a global
+  bounded queue (the PR 5 named-shed semantics: a spike sheds with
+  ``rejected: router admission queue full`` instead of growing an
+  unbounded host queue) and a pump thread dispatches **least-loaded**
+  over the replicas' live occupancy (queued + active slots — host
+  ints, never a device read).  Deadlines are converted to an
+  **absolute** ``deadline_at`` at router intake, so time queued in
+  front of a replica counts against the budget (Request docstring).
+
+* **health + failure detection** — each replica runs under the
+  :class:`~dtdl_tpu.serve.health.ReplicaHealth` state machine
+  ``HEALTHY → SUSPECT → EVICTED → DRAINING → HEALTHY``.  Passive
+  signals are free: engine containments (``last_engine_error``),
+  failed attempt completions, a stalled worker heartbeat past
+  ``watchdog_s``, a dead worker thread.  Active probes are periodic
+  host-side health checks.  SUSPECT is the **circuit breaker** —
+  dispatch stops at the first signal, before the replica is declared
+  dead, bounding wasted work to what was already in flight.
+
+* **retry + hedging** — attempts lost to a containment or an eviction
+  are re-dispatched with a ``retry_budget``.  Greedy decode is
+  deterministic and every replica serves the same params, so a retried
+  request completes **token-identical** to an unfailed run — the
+  failover acceptance oracle (tests/test_fleet.py) — or carries a
+  named ``failed: retry budget exhausted`` error.  The opt-in hedge
+  policy (``hedge_after_s``) re-submits a straggler to a second
+  replica; the first completion wins, the loser is cancelled
+  (:meth:`Scheduler.cancel`), and delivery is exactly-once by
+  construction: only the first finished attempt copies tokens into the
+  caller's request, later completions of the same flight are dropped.
+
+* **lifecycle** — :meth:`Router.drain_replica` / ``rolling_restart``
+  take one replica through DRAINING (no new dispatch, in-flight work
+  finishes) and restart it behind the router while the rest keep
+  serving; an EVICTED replica is refilled the same way (failover
+  first, then DRAINING → fresh worker → HEALTHY).  MTTR = detect
+  (watchdog/probe) + drain + refill — SCALING.md "Fleet failure
+  model".
+
+The router is **host-side only**: it owns threads, deques, and health
+bits — never a device value — so the zero-per-token-sync discipline of
+the replica hot path is untouched (the RecompileSentinel receipts in
+test_serve/test_paged_kv/test_quant/test_spec_decode pass unchanged).
+Replicas may share one :class:`InferenceEngine` (same compiled
+programs, same params — the cheap CPU-testable construction, and the
+reason retried output is bit-identical) or bring their own (e.g. one
+per device).  Fault injection rides :func:`dtdl_tpu.resil.faults.
+replica_site`: per-replica ``engine`` / ``loop`` / ``probe`` sites make
+every health transition deterministically reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Optional, Sequence
+
+from dtdl_tpu.obs.hist import LogHistogram
+from dtdl_tpu.obs.observer import NULL_OBSERVER
+from dtdl_tpu.resil.faults import FaultPlan, InjectedFault, replica_site
+from dtdl_tpu.serve.health import (DRAINING, EVICTED, HEALTHY, SUSPECT,
+                                   ReplicaHealth)
+from dtdl_tpu.serve.metrics import ServeMetrics
+from dtdl_tpu.serve.scheduler import Request, Scheduler
+
+
+class _FaultableEngine:
+    """Replica-scoped fault shim over an InferenceEngine: fires the
+    replica's ``engine`` fault site before every compiled-program
+    dispatch (prefill / decode / verify), so a FaultPlan can raise on
+    exactly the k-th program call of replica i — the deterministic
+    handle for exercising ``Scheduler._contain`` and the Router's
+    passive containment signal.  Everything else (attributes, the other
+    methods, attribute writes) passes through to the wrapped engine, so
+    the Scheduler cannot tell the difference."""
+
+    def __init__(self, engine, plan: FaultPlan, site: str):
+        object.__setattr__(self, "_engine", engine)
+        object.__setattr__(self, "_plan", plan)
+        object.__setattr__(self, "_site", site)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def __setattr__(self, name, value):
+        setattr(self._engine, name, value)
+
+    def prefill(self, *a, **kw):
+        self._plan.fire(self._site)
+        return self._engine.prefill(*a, **kw)
+
+    def decode(self, *a, **kw):
+        self._plan.fire(self._site)
+        return self._engine.decode(*a, **kw)
+
+    def verify(self, *a, **kw):
+        self._plan.fire(self._site)
+        return self._engine.verify(*a, **kw)
+
+
+def _sched_idle(sched: Scheduler) -> bool:
+    return (not sched.queue and not sched._pending
+            and all(x is None for x in sched.slots))
+
+
+class Replica:
+    """One thread-hosted Scheduler+InferenceEngine pair behind the
+    Router.
+
+    The worker thread OWNS the scheduler — the only cross-thread
+    surface is the inbox/cancel/completion deques under one condition
+    variable, plus read-only int peeks (``load``) for routing.  The
+    worker heart-beats every iteration (``last_beat``), which is what
+    the Router's stall watchdog and probes read; a ``loop``-site fault
+    can kill (``raise``) or freeze (``stall``) the worker to model a
+    wedged replica.  ``restart()`` generation-fences the old worker
+    (a wedged thread is abandoned — daemon — and exits at its next
+    fence check) and rebuilds a fresh Scheduler on the same engine:
+    compiled programs are reused, the arena is fresh, and the replica's
+    cumulative :class:`ServeMetrics` survives the swap."""
+
+    def __init__(self, idx: int, engine, sched_kwargs: dict | None = None,
+                 plan: Optional[FaultPlan] = None, observer=None,
+                 idle_wait_s: float = 0.002):
+        self.idx = idx
+        self.engine = engine
+        self.plan = plan
+        self.observer = observer or NULL_OBSERVER
+        self.idle_wait_s = idle_wait_s
+        self._sched_kwargs = dict(sched_kwargs or {})
+        self.metrics = self._sched_kwargs.pop(
+            "metrics", None) or ServeMetrics(n_slots=engine.n_slots)
+        self._cv = threading.Condition()
+        self._inbox: deque[Request] = deque()
+        self._cancels: deque[tuple[int, str]] = deque()
+        self.completions: deque[Request] = deque()
+        self._on_complete = None          # Router wake hook
+        self._gen = 0                     # restart fence
+        self.dead_error: Optional[str] = None
+        self.dead_at: Optional[float] = None
+        self.last_beat = time.perf_counter()
+        self.restarts = 0
+        self.sched = self._make_sched()
+        self._thread = self._spawn()
+
+    def _make_sched(self) -> Scheduler:
+        engine = self.engine
+        if self.plan is not None:
+            engine = _FaultableEngine(
+                engine, self.plan, replica_site(self.idx, "engine"))
+        sched = Scheduler(engine, metrics=self.metrics,
+                          **self._sched_kwargs)
+        sched._fleet_published = 0   # per-generation completion cursor
+        return sched
+
+    def _spawn(self) -> threading.Thread:
+        t = threading.Thread(target=self._run, args=(self._gen,),
+                             name=f"serve-replica{self.idx}", daemon=True)
+        t.start()
+        return t
+
+    # ---- router-facing (any thread) ----------------------------------
+
+    def submit(self, req: Request) -> None:
+        with self._cv:
+            self._inbox.append(req)
+            self._cv.notify_all()
+
+    def cancel(self, rid: int, reason: str) -> None:
+        with self._cv:
+            self._cancels.append((rid, reason))
+            self._cv.notify_all()
+
+    def drain_completions(self) -> list[Request]:
+        with self._cv:
+            out = list(self.completions)
+            self.completions.clear()
+        return out
+
+    @property
+    def load(self) -> int:
+        """Queued + active work, inbox included — the least-loaded
+        routing key.  Plain int reads; sampling it never stops the
+        worker."""
+        return len(self._inbox) + self.sched.load
+
+    @property
+    def idle(self) -> bool:
+        return not self._inbox and _sched_idle(self.sched)
+
+    def probe(self) -> bool:
+        """Lightweight active health probe — host-only, no device work:
+        the fault plan's ``probe`` site may blackhole (no answer) or
+        raise (the health endpoint itself crashing); otherwise healthy
+        means the worker thread is alive and did not die on an injected
+        loop fault.  Heartbeat *freshness* is judged by the Router,
+        which owns ``watchdog_s``."""
+        if self.plan is not None:
+            try:
+                f = self.plan.fire(replica_site(self.idx, "probe"))
+            except InjectedFault:
+                return False
+            if f is not None and f.kind == "blackhole":
+                return False
+        return self._thread.is_alive() and self.dead_error is None
+
+    def restart(self, join_timeout_s: float = 2.0) -> None:
+        with self._cv:
+            self._gen += 1               # fence: old worker exits at its
+            self._cv.notify_all()        # next check, even mid-stall
+        self._thread.join(timeout=join_timeout_s)
+        with self._cv:
+            self._inbox.clear()
+            self._cancels.clear()
+        self.sched = self._make_sched()
+        self.dead_error = None
+        self.dead_at = None
+        self.last_beat = time.perf_counter()
+        self.restarts += 1
+        self._thread = self._spawn()
+
+    def stop(self, drain: bool = True, join_timeout_s: float = 5.0) -> None:
+        """Stop the worker, then wind the scheduler down on the calling
+        thread (safe: the worker is fenced out first)."""
+        with self._cv:
+            self._gen += 1
+            self._cv.notify_all()
+        self._thread.join(timeout=join_timeout_s)
+        if self._thread.is_alive():
+            # wedged worker outlived the join: it still owns this
+            # scheduler, so winding it down from here would race the
+            # worker's eventual wake-up.  Abandon the generation — its
+            # completions die with it, exactly the dead-replica
+            # semantics (the gen fence drops any late publish).
+            return
+        try:
+            self.sched.shutdown(drain=drain)
+        except Exception:      # a broken engine must not block shutdown
+            pass
+        self._publish_from(self.sched)
+
+    # ---- the worker ---------------------------------------------------
+
+    def _run(self, gen: int) -> None:
+        # the worker binds ITS generation's scheduler: after a restart
+        # swaps self.sched, a stale worker waking from a stall keeps
+        # touching only its own abandoned scheduler (and its publishes
+        # are dropped by the generation check) — it can never leak work
+        # into the replacement
+        sched = self.sched
+        while True:
+            with self._cv:
+                while (gen == self._gen and not self._inbox
+                       and not self._cancels and _sched_idle(sched)):
+                    self.last_beat = time.perf_counter()
+                    self._cv.wait(timeout=self.idle_wait_s)
+                if gen != self._gen:
+                    return
+                subs = list(self._inbox)
+                self._inbox.clear()
+                cans = list(self._cancels)
+                self._cancels.clear()
+            self.last_beat = time.perf_counter()
+            if self.plan is not None:
+                try:
+                    # "stall" sleeps HERE with the heartbeat frozen (the
+                    # watchdog's trigger); "raise" kills this worker —
+                    # heartbeat stops for good and probes fail
+                    self.plan.fire(replica_site(self.idx, "loop"))
+                except InjectedFault as e:
+                    self.dead_error = f"{type(e).__name__}: {e}"
+                    self.dead_at = time.perf_counter()
+                    return
+            for r in subs:
+                sched.submit(r)
+            for rid, reason in cans:
+                sched.cancel(rid, reason)
+            if sched.queue or any(x is not None for x in sched.slots):
+                sched.step()
+            elif sched._pending:
+                sched.drain()
+            self._publish_from(sched, gen)
+
+    def _publish_from(self, sched: Scheduler,
+                      gen: Optional[int] = None) -> None:
+        """Move newly finished requests of ``sched`` into the completion
+        deque.  The cursor lives on the scheduler, so each generation's
+        book is its own; a stale worker (``gen`` no longer current) is
+        dropped under the lock — its completions die with it, exactly
+        like a real dead replica's."""
+        n = len(sched.finished)
+        if sched._fleet_published >= n:
+            return
+        with self._cv:
+            if gen is not None and gen != self._gen:
+                return
+            while sched._fleet_published < n:
+                self.completions.append(
+                    sched.finished[sched._fleet_published])
+                sched._fleet_published += 1
+        if self._on_complete is not None:
+            self._on_complete()
+
+
+class FleetMetrics:
+    """Router-level accounting plus fleet-wide tails.
+
+    The fleet-level invariant mirrors PR 5's per-scheduler one::
+
+        submitted == finished + rejected + expired + failed + aborted
+
+    with each USER request counted exactly once no matter how many
+    replica *attempts* (retries, hedges, failovers) served it — the
+    attempt churn lands in its own ledger (``retries`` / ``hedges`` /
+    ``hedges_won`` / ``evictions`` / ``failovers`` / ``restarts``).
+    Per-replica :class:`ServeMetrics` keep their own books (a replica's
+    attempt-level invariant can legitimately dangle across a worker
+    death — those attempts are the router's to re-dispatch, which is
+    the point); :meth:`summary` nests them under ``replicas``.
+    TTFT/per-token tails are **router-clock** (from router submit, so
+    queue time and failovers are inside the number) through the same
+    fixed-memory :class:`~dtdl_tpu.obs.hist.LogHistogram` as PR 3.
+    """
+
+    def __init__(self):
+        self.n_submitted = 0
+        self.n_finished = 0
+        self.n_rejected = 0
+        self.n_expired = 0
+        self.n_failed = 0
+        self.n_aborted = 0
+        self.retries = 0
+        self.hedges = 0
+        self.hedges_won = 0
+        self.evictions = 0
+        self.failovers = 0
+        self.restarts = 0
+        self.ttft_hist = LogHistogram()
+        self.tok_latency_hist = LogHistogram()
+        self._t_start: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # ---- router hooks -------------------------------------------------
+
+    def on_submit(self):
+        self.n_submitted += 1
+        if self._t_start is None:
+            self._t_start = time.perf_counter()
+
+    def on_reject(self):
+        self.n_submitted += 1
+        self.n_rejected += 1
+
+    def on_reject_terminal(self):
+        """A deterministic replica-side rejection surfaced as the user
+        outcome (prompt past every bucket, never-fits page pool):
+        counted in rejected WITHOUT the submit increment of
+        :meth:`on_reject` — the request was already counted at router
+        intake, and the invariant needs exactly one terminal entry."""
+        self.n_rejected += 1
+
+    def on_expire(self):
+        self.n_expired += 1
+
+    def on_failed(self):
+        self.n_failed += 1
+
+    def on_abort(self):
+        self.n_aborted += 1
+
+    def on_finish(self, user: Request, attempt: Request):
+        self.n_finished += 1
+        self._t_last = time.perf_counter()
+        if attempt.t_first and user.t_submit:
+            self.ttft_hist.add(attempt.t_first - user.t_submit)
+        n_dec = len(attempt.tokens) - 1
+        if n_dec > 0 and attempt.t_done > attempt.t_first:
+            self.tok_latency_hist.add(
+                (attempt.t_done - attempt.t_first) / n_dec)
+
+    def on_retry(self):
+        self.retries += 1
+
+    def on_hedge(self):
+        self.hedges += 1
+
+    def on_hedge_won(self):
+        self.hedges_won += 1
+
+    def on_eviction(self, n_failover: int):
+        self.evictions += 1
+        self.failovers += n_failover
+
+    def on_restart(self):
+        self.restarts += 1
+
+    # ---- aggregation --------------------------------------------------
+
+    @property
+    def accounted(self) -> int:
+        return (self.n_finished + self.n_rejected + self.n_expired
+                + self.n_failed + self.n_aborted)
+
+    def summary(self, replicas: Sequence[dict] = (),
+                health: Sequence[str] = ()) -> dict:
+        replicas = list(replicas)
+        wall = 0.0
+        if self._t_start is not None and self._t_last is not None:
+            wall = self._t_last - self._t_start
+        decode_tokens = sum(r.get("decode_tokens", 0) for r in replicas)
+        return {
+            "fleet_requests_submitted": self.n_submitted,
+            "fleet_requests_finished": self.n_finished,
+            "fleet_requests_rejected": self.n_rejected,
+            "fleet_requests_expired": self.n_expired,
+            "fleet_requests_failed": self.n_failed,
+            "fleet_requests_aborted": self.n_aborted,
+            # the invariant receipt: every submitted user request
+            # reached exactly one terminal ledger entry
+            "fleet_accounting_ok": self.n_submitted == self.accounted,
+            "fleet_retries": self.retries,
+            "fleet_hedges": self.hedges,
+            "fleet_hedges_won": self.hedges_won,
+            "fleet_evictions": self.evictions,
+            "fleet_failovers": self.failovers,
+            "fleet_restarts": self.restarts,
+            "fleet_wall_s": round(wall, 6),
+            "fleet_decode_tokens": decode_tokens,
+            "fleet_decode_tokens_per_sec": round(decode_tokens / wall, 2)
+            if wall > 0 else 0.0,
+            # the mean keys stay present under zero traffic (same
+            # empty-case contract as ServeMetrics.summary); recorded
+            # samples overwrite them via the histogram merges below
+            "fleet_ttft_s_mean": 0.0, "fleet_tok_latency_s_mean": 0.0,
+            **self.ttft_hist.summary("fleet_ttft_s_"),
+            **self.tok_latency_hist.summary("fleet_tok_latency_s_"),
+            "replica_health": list(health),
+            "replicas": replicas,
+        }
+
+
+@dataclasses.dataclass
+class _Flight:
+    """Router-side lifecycle record of one USER request: the attempts
+    (replica-local Request clones) that have served it, which are still
+    live, how many retries it has burned, and whether it was hedged."""
+    req: Request
+    t_router: float
+    live: dict = dataclasses.field(default_factory=dict)   # rid -> replica
+    attempts: list = dataclasses.field(default_factory=list)
+    retries: int = 0
+    hedged: bool = False
+    hedge_rid: Optional[int] = None
+
+
+class Router:
+    """Health-checked fleet front end (see module docstring).
+
+    ``engines`` is one :class:`InferenceEngine` (replicated
+    ``n_replicas`` times — shared compiled programs and params, the
+    CPU-testable construction) or a sequence of engines, one per
+    replica.  ``sched_kwargs`` goes to every replica's Scheduler
+    (harvest_lag, draft, prefix_cache, ...).  ``plan`` arms the
+    per-replica fault sites (:func:`~dtdl_tpu.resil.faults.
+    replica_site`).  Health knobs: ``probe_interval_s`` /
+    ``watchdog_s`` / ``suspect_after`` / ``evict_after`` /
+    ``recover_after``; ``auto_restart`` refills an evicted replica
+    automatically (detect → failover → DRAINING → fresh worker).
+    ``retry_budget`` bounds re-dispatches per request; ``hedge_after_s``
+    (opt-in) re-submits stragglers to a second replica,
+    first-completion-wins.
+    """
+
+    def __init__(self, engines, n_replicas: Optional[int] = None,
+                 sched_kwargs: dict | None = None,
+                 max_queue: Optional[int] = None, retry_budget: int = 2,
+                 hedge_after_s: Optional[float] = None,
+                 probe_interval_s: float = 0.02,
+                 watchdog_s: float = 0.5, suspect_after: int = 1,
+                 evict_after: int = 2, recover_after: int = 2,
+                 auto_restart: bool = True, metrics: FleetMetrics = None,
+                 observer=None, plan: Optional[FaultPlan] = None,
+                 poll_s: float = 0.002, warmup: bool = True):
+        if isinstance(engines, (list, tuple)):
+            engines = list(engines)
+            if n_replicas is not None and n_replicas != len(engines):
+                raise ValueError(f"n_replicas={n_replicas} but "
+                                 f"{len(engines)} engines given")
+        else:
+            if n_replicas is not None and n_replicas < 1:
+                raise ValueError(f"n_replicas must be >= 1, got "
+                                 f"{n_replicas}")
+            engines = [engines] * (2 if n_replicas is None else n_replicas)
+        if not engines:
+            raise ValueError("need at least one engine")
+        if retry_budget < 0:
+            raise ValueError(f"retry_budget must be >= 0, got "
+                             f"{retry_budget}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.observer = observer or NULL_OBSERVER
+        self.metrics = metrics or FleetMetrics()
+        self.max_queue = max_queue
+        self.retry_budget = retry_budget
+        self.hedge_after_s = hedge_after_s
+        self.probe_interval_s = probe_interval_s
+        self.watchdog_s = watchdog_s
+        self.auto_restart = auto_restart
+        self.poll_s = poll_s
+        if warmup:
+            # compile the smallest prefill bucket + the decode program
+            # SYNCHRONOUSLY, before any worker thread owns traffic: a
+            # first-call compile takes seconds, during which a worker
+            # cannot heartbeat — the stall watchdog would read a busy,
+            # silent replica as wedged and spuriously evict it.  (Other
+            # prefill buckets still compile lazily; for models whose
+            # compiles outrun watchdog_s, warm those buckets here too
+            # or raise watchdog_s.)
+            wk = dict(sched_kwargs or {})
+            wk.pop("metrics", None)    # never count warmup as traffic
+            seen: set[int] = set()
+            for eng in engines:
+                if id(eng) in seen:
+                    continue
+                seen.add(id(eng))
+                Scheduler(eng, **wk).run([Request([0], 2)])
+        self.replicas = [
+            Replica(i, eng, sched_kwargs, plan, self.observer)
+            for i, eng in enumerate(engines)]
+        self.health = [
+            ReplicaHealth(suspect_after, evict_after, recover_after)
+            for _ in engines]
+        self._cv = threading.Condition()
+        self.queue: deque[_Flight] = deque()
+        self._flights: dict[int, _Flight] = {}      # user rid -> flight
+        self._by_attempt: dict[int, _Flight] = {}   # attempt rid -> flight
+        # diagnostics with FIXED memory under unbounded traffic (the
+        # same discipline as the capped sample lists in ServeMetrics):
+        # finished/dispatch_log keep the most recent entries, counts
+        # live in FleetMetrics; evict_log stays unbounded — evictions
+        # are rare by construction and each entry is the MTTR receipt
+        self.finished: deque[Request] = deque(maxlen=65536)
+        self.dispatch_log: deque[tuple[float, int, int, int]] = \
+            deque(maxlen=65536)
+        self.evict_log: list[dict] = []
+        self._engine_errs: list[Optional[str]] = [None] * len(engines)
+        self._last_stall: list[float] = [0.0] * len(engines)
+        self._tick_signaled: set[int] = set()
+        self._last_probe = 0.0
+        self._closed = False
+        self._stop = False
+        self.pump_error: Optional[str] = None
+        for rep in self.replicas:
+            rep._on_complete = self._wake
+        self._pump = threading.Thread(target=self._pump_loop,
+                                      name="serve-router", daemon=True)
+        self._pump.start()
+
+    # ---- intake -------------------------------------------------------
+
+    def _wake(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+    def submit(self, req: Request) -> Request:
+        """Enqueue ``req`` for the fleet; rejections come back with the
+        same named ``req.error`` semantics as :meth:`Scheduler.submit`
+        (shut-down router, full admission queue) instead of raising."""
+        now = time.perf_counter()
+        req.t_submit = now
+        if req.deadline_at is None and req.deadline_s is not None:
+            # absolute from ROUTER intake: queue time counts
+            req.deadline_at = now + req.deadline_s
+        with self._cv:
+            if self._closed:
+                return self._terminal_locked(
+                    req, "rejected: router is shut down",
+                    self.metrics.on_reject)
+            if (self.max_queue is not None
+                    and len(self.queue) >= self.max_queue):
+                return self._terminal_locked(
+                    req, f"rejected: router admission queue full "
+                         f"({self.max_queue} waiting); retry later",
+                    self.metrics.on_reject)
+            self.metrics.on_submit()
+            fl = _Flight(req, now)
+            self._flights[req.rid] = fl
+            self.queue.append(fl)
+            self._cv.notify_all()
+        return req
+
+    def _terminal_locked(self, req: Request, error: str,
+                         hook) -> Request:
+        """Finish a user request terminally; caller holds the lock."""
+        req.error = error
+        req.done = True
+        req.t_done = time.perf_counter()
+        hook()
+        self.finished.append(req)
+        self._cv.notify_all()
+        return req
+
+    def _finish_user(self, fl: _Flight, error: Optional[str], hook,
+                     attempt: Optional[Request] = None) -> None:
+        """Terminal outcome of a flight (lock NOT held): deliver or
+        error the user request exactly once, cancel leftover live
+        attempts, prune the flight."""
+        user = fl.req
+        with self._cv:
+            if user.done:
+                return
+            if error is None and attempt is not None:
+                user.tokens = list(attempt.tokens)
+                user.error = None
+                user.t_admit = attempt.t_admit
+                user.t_first = attempt.t_first
+                user.t_done = attempt.t_done
+                user.done = True
+                self.metrics.on_finish(user, attempt)
+            else:
+                user.error = error
+                user.done = True
+                user.t_done = time.perf_counter()
+                hook()
+            self._flights.pop(user.rid, None)
+            losers = list(fl.live.items())
+            fl.live.clear()
+            for rid, _ in losers:
+                # drop the losers from the attempt table NOW: their
+                # completions (or cancels) may never arrive if their
+                # replica dies first, and a decided flight needs no
+                # routing — late completions fall out at _collect's
+                # fl-is-None check
+                self._by_attempt.pop(rid, None)
+            self.finished.append(user)
+            self._cv.notify_all()
+        for rid, j in losers:
+            # best-effort: a loser past cancellation finishes on its
+            # replica and is dropped at collection (user already done)
+            self.replicas[j].cancel(rid, "lost the race")
+
+    # ---- the pump -----------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+                self._cv.wait(timeout=self.poll_s)
+                if self._stop:
+                    return
+            try:
+                self._tick()
+            except Exception as e:     # the pump must outlive any bug:
+                self.pump_error = f"{type(e).__name__}: {e}"
+                self.observer.event("router_pump_error",
+                                    error=self.pump_error)
+
+    def _tick(self) -> None:
+        # one health signal per replica per tick: a single root cause
+        # (an engine containment failing every slotted attempt at once)
+        # produces a BURST of error completions in one _collect pass —
+        # undeduplicated they would walk HEALTHY straight through
+        # SUSPECT to EVICTED inside one tick, and the circuit-breaker
+        # grace window (probe recovery for transient hiccups) could
+        # never engage.  Genuinely repeated sickness signals again on
+        # later ticks and still evicts in a handful of ms.
+        self._tick_signaled.clear()
+        self._collect()
+        self._health_check()
+        self._expire_queued()
+        self._dispatch()
+        self._hedge()
+
+    # ---- completions --------------------------------------------------
+
+    def _collect(self) -> None:
+        for i, rep in enumerate(self.replicas):
+            for att in rep.drain_completions():
+                with self._cv:     # all _by_attempt/fl.live mutation is
+                    fl = self._by_attempt.pop(att.rid, None)   # locked
+                    if fl is not None:
+                        fl.live.pop(att.rid, None)
+                if fl is None:
+                    continue           # stale (evicted-and-failed-over)
+                self._attempt_done(fl, att, i)
+
+    def _attempt_done(self, fl: _Flight, att: Request, i: int) -> None:
+        user = fl.req
+        if att.error is None:
+            self.health[i].on_success()
+            if fl.hedged and att.rid == fl.hedge_rid and not user.done:
+                self.metrics.on_hedge_won()
+                self.observer.event("hedge_won", rid=user.rid, replica=i)
+            self._finish_user(fl, None, None, attempt=att)
+            return
+        kind = att.error.split(":", 1)[0]
+        if user.done:
+            return                     # a raced loser; already delivered
+        if kind == "expired":
+            # the deadline is global — retrying cannot un-expire it
+            self._finish_user(fl, att.error, self.metrics.on_expire)
+            return
+        if kind == "aborted" and "cancelled" in att.error:
+            # our own cancel (hedge loser / eviction supersede): the
+            # flight's fate is decided elsewhere
+            return
+        with self._cv:
+            hedge_alive = bool(fl.live)
+        if kind == "rejected":
+            # an ADMISSION decision, never replica sickness — no health
+            # signal (a rejection says "no" to one request; treating it
+            # as a failure signal would let one bad request or a burst
+            # open circuits and evict healthy replicas fleet-wide)
+            if ("queue full" in att.error
+                    or "containment in progress" in att.error):
+                # transient backpressure: requeue at the TAIL without
+                # burning the retry budget — a slot frees in seconds
+                # while the budget would burn in milliseconds of pump
+                # ticks (the _pick capacity gate paces re-dispatch; the
+                # deadline watchdog still bounds total waiting)
+                if not hedge_alive:
+                    with self._cv:
+                        self.queue.append(fl)
+                        self._cv.notify_all()
+                return
+            # deterministic rejection (prompt past every bucket, pool
+            # can never fit it): identical on every replica — surface
+            # it as the user outcome instead of churning retries
+            self._finish_user(fl, att.error,
+                              self.metrics.on_reject_terminal)
+            return
+        if kind != "shed":
+            # failed / replica-shutdown abort: a passive replica-health
+            # signal (a mid-flight page-pool shed is a CAPACITY signal,
+            # worth retrying elsewhere but not sickness)
+            self._signal(i, f"attempt error: {att.error}")
+        if hedge_alive:
+            # the flight's hedge is still running on another replica:
+            # its completion decides the outcome — burning a retry (or
+            # the whole budget) on the already-covered failure would
+            # waste a dispatch at best and terminally fail a request
+            # whose live attempt was about to deliver at worst
+            return
+        self._retry_or_fail(fl, att.error)
+
+    def _retry_or_fail(self, fl: _Flight, error: str) -> None:
+        user = fl.req
+        now = time.perf_counter()
+        if user.deadline_at is not None and now >= user.deadline_at:
+            self._finish_user(
+                fl, f"expired: deadline exceeded after {fl.retries} "
+                    f"retries (last: {error})", self.metrics.on_expire)
+            return
+        if fl.retries >= self.retry_budget:
+            self._finish_user(
+                fl, f"failed: retry budget exhausted "
+                    f"({self.retry_budget}); last error: {error}",
+                self.metrics.on_failed)
+            return
+        fl.retries += 1
+        self.metrics.on_retry()
+        self.observer.event("request_retry", rid=user.rid, n=fl.retries)
+        with self._cv:
+            self.queue.appendleft(fl)
+            self._cv.notify_all()
+
+    # ---- health -------------------------------------------------------
+
+    def _signal(self, i: int, reason: str) -> None:
+        if i in self._tick_signaled:
+            return                     # burst dedup (see _tick)
+        self._tick_signaled.add(i)
+        h = self.health[i]
+        prev = h.state
+        state = h.on_signal(reason)
+        if state != prev:
+            self.observer.event(f"replica_{state}", replica=i,
+                                reason=reason[:200])
+        if state == EVICTED and prev != EVICTED:
+            self._evict(i, reason)
+
+    def _busy(self, i: int) -> bool:
+        """Does the router believe replica ``i`` holds outstanding
+        work?  Judged from the router's OWN live-attempt table (plus
+        the replica's visible state): a worker that stalled before even
+        submitting its inbox batch looks idle from its scheduler, but
+        the attempts the router handed it are still outstanding — and
+        that is exactly the case the watchdog exists for."""
+        with self._cv:
+            if any(rep == i for fl in self._by_attempt.values()
+                   for rep in fl.live.values()):
+                return True
+        return not self.replicas[i].idle
+
+    def _health_check(self) -> None:
+        now = time.perf_counter()
+        for i, rep in enumerate(self.replicas):
+            if self.health[i].state in (EVICTED, DRAINING):
+                continue
+            err = rep.sched.last_engine_error
+            if err is not None and err != self._engine_errs[i]:
+                self._engine_errs[i] = err
+                self._signal(i, f"engine containment: {err}")
+            if rep.dead_error is not None:
+                self._signal(i, f"worker died: {rep.dead_error}")
+            elif (self._busy(i)
+                  and now - rep.last_beat > self.watchdog_s):
+                # harvest stall watchdog: work outstanding but the
+                # worker heartbeat went stale.  Rate-limited to one
+                # signal per watchdog window so a single long stall
+                # cannot burn the whole evict budget by itself.
+                if now - self._last_stall[i] > self.watchdog_s:
+                    self._last_stall[i] = now
+                    self._signal(
+                        i, f"harvest stall: no heartbeat for "
+                           f"{now - rep.last_beat:.3f}s "
+                           f"(watchdog {self.watchdog_s}s)")
+        if now - self._last_probe < self.probe_interval_s:
+            return
+        self._last_probe = now
+        for i, rep in enumerate(self.replicas):
+            h = self.health[i]
+            if h.state in (EVICTED, DRAINING):
+                continue
+            ok = rep.probe()
+            if (ok and self._busy(i)
+                    and now - rep.last_beat > self.watchdog_s):
+                ok = False             # alive but wedged counts as down
+            prev = h.state
+            state = h.on_probe(ok)
+            if state != prev:
+                self.observer.event(f"replica_{state}", replica=i,
+                                    probe_ok=int(ok))
+                if state == EVICTED:
+                    self._evict(i, "probe failures")
+
+    def _fail_over(self, i: int, why: str) -> int:
+        """Abandon every live attempt on replica ``i`` (best-effort
+        cancelled) and re-dispatch its flights under the retry budget;
+        returns how many moved.  Shared by eviction and a timed-out
+        drain — either way, an accepted request must reach a terminal
+        state somewhere else, never be silently orphaned."""
+        with self._cv:
+            victims = []
+            for rid, fl in [(r, f) for r, f in self._by_attempt.items()
+                            if f.live.get(r) == i]:
+                self._by_attempt.pop(rid, None)
+                fl.live.pop(rid, None)
+                victims.append((rid, fl))
+        moved = 0
+        for rid, fl in victims:
+            self.replicas[i].cancel(rid, f"replica {why}")
+            if fl.req.done:
+                continue
+            if fl.live:
+                continue               # a hedge still runs elsewhere
+            moved += 1
+            self._retry_or_fail(fl, f"failed: replica {i} {why}")
+        return moved
+
+    def _evict(self, i: int, reason: str) -> None:
+        """Failover: every live attempt on replica ``i`` is abandoned
+        (best-effort cancelled) and its flight re-dispatched under the
+        retry budget; then the replica is optionally refilled
+        (DRAINING → fresh worker → HEALTHY)."""
+        moved = self._fail_over(i, f"evicted ({reason})")
+        self.metrics.on_eviction(moved)
+        now = time.perf_counter()
+        dead_at = self.replicas[i].dead_at
+        self.evict_log.append({
+            "t": now, "replica": i, "reason": reason[:200],
+            "failovers": moved,
+            # detection latency, when the death instant is known (a
+            # worker-death fault stamps it): the MTTR "detect" term
+            "detect_latency_s": round(now - dead_at, 6)
+            if dead_at is not None else None,
+        })
+        self.observer.event("replica_evicted", replica=i,
+                            reason=reason[:200], failovers=moved)
+        if self.auto_restart:
+            self._refill(i)
+
+    def _refill(self, i: int) -> None:
+        """Replace an evicted replica: DRAINING (nothing left to drain —
+        failover already moved its work) → fresh worker → HEALTHY.
+        Runs on the pump thread, so the old-worker join is SHORT: a
+        cleanly dead thread joins instantly, a wedged one is simply
+        abandoned behind the generation fence rather than freezing
+        fleet-wide dispatch for the full join timeout."""
+        with self._cv:
+            self.health[i].start_drain("replacing evicted replica")
+        self.observer.event("replica_draining", replica=i,
+                            reason="refill")
+        self.replicas[i].restart(join_timeout_s=0.1)
+        self._engine_errs[i] = None
+        self.metrics.on_restart()
+        with self._cv:
+            self.health[i].on_restarted()
+        self.observer.event("replica_restarted", replica=i)
+
+    # ---- dispatch -----------------------------------------------------
+
+    def _expire_queued(self) -> None:
+        now = time.perf_counter()
+        expired = []
+        with self._cv:
+            for fl in [f for f in self.queue
+                       if f.req.deadline_at is not None
+                       and now >= f.req.deadline_at]:
+                self.queue.remove(fl)
+                expired.append(fl)
+        for fl in expired:
+            self._finish_user(
+                fl, "expired: deadline exceeded in router queue",
+                self.metrics.on_expire)
+
+    def _pick(self, exclude: Optional[int] = None) -> Optional[int]:
+        """Least-loaded over dispatchable (HEALTHY) replicas WITH
+        CAPACITY — the circuit breaker and lifecycle states are
+        excluded (the never-dispatch-to-SUSPECT/EVICTED/DRAINING
+        guarantee), and so is any replica already holding 2x its slot
+        count: dispatch keeps only enough replica-side buffer to
+        pipeline admission, so backlog accumulates in the ROUTER queue
+        where ``max_queue`` can actually shed it (eagerly draining the
+        queue into replica inboxes would make the bounded-admission
+        contract a no-op).  ``exclude`` lets the hedge path require a
+        DIFFERENT replica."""
+        cands = [i for i, h in enumerate(self.health)
+                 if h.dispatchable and i != exclude
+                 and self.replicas[i].load
+                 < 2 * self.replicas[i].engine.n_slots]
+        if not cands:
+            return None
+        return min(cands, key=lambda i: (self.replicas[i].load, i))
+
+    def _dispatch(self) -> None:
+        with self.observer.span("route"):
+            while True:
+                dead = None
+                with self._cv:
+                    if not self.queue:
+                        return
+                    target = self._pick()
+                    if target is None:
+                        # SUSPECT and DRAINING recover; a fleet that is
+                        # ENTIRELY evicted (no auto_restart) never will
+                        # — fail the queue by name instead of hanging
+                        if all(h.state == EVICTED for h in self.health):
+                            dead = list(self.queue)
+                            self.queue.clear()
+                        else:
+                            return     # circuits open: wait for probes
+                    else:
+                        fl = self.queue.popleft()
+                        if fl.req.done:
+                            continue
+                        att = self._clone(fl.req)
+                        now = time.perf_counter()
+                        fl.live[att.rid] = target
+                        fl.attempts.append((att.rid, target, now))
+                        self._by_attempt[att.rid] = fl
+                        self.dispatch_log.append(
+                            (now, target, fl.req.rid, att.rid))
+                if dead is not None:
+                    for fl in dead:
+                        self._finish_user(
+                            fl, "failed: no healthy replica (every "
+                                "replica evicted)",
+                            self.metrics.on_failed)
+                    return
+                self.replicas[target].submit(att)
+
+    def _clone(self, user: Request) -> Request:
+        """A fresh replica-local attempt for a user request: same
+        generation parameters, its own rid/lifecycle, and the USER's
+        absolute deadline — router queue time and earlier failed
+        attempts all count against the one budget."""
+        return Request(list(user.prompt), user.max_new_tokens,
+                       sampling=user.sampling, eos_id=user.eos_id,
+                       speculate=user.speculate,
+                       deadline_at=user.deadline_at)
+
+    def _hedge(self) -> None:
+        if self.hedge_after_s is None:
+            return
+        now = time.perf_counter()
+        todo = []
+        with self._cv:
+            for fl in self._flights.values():
+                if (fl.req.done or fl.hedged or len(fl.live) != 1
+                        or not fl.attempts):
+                    continue
+                _, first_rep, t_disp = fl.attempts[-1]
+                if now - t_disp < self.hedge_after_s:
+                    continue
+                j = self._pick(exclude=first_rep)
+                if j is None:
+                    continue
+                att = self._clone(fl.req)
+                fl.hedged = True
+                fl.hedge_rid = att.rid
+                fl.live[att.rid] = j
+                fl.attempts.append((att.rid, j, now))
+                self._by_attempt[att.rid] = fl
+                self.dispatch_log.append((now, j, fl.req.rid, att.rid))
+                self.metrics.on_hedge()
+                todo.append((j, att, fl.req.rid))
+        for j, att, rid in todo:
+            self.observer.event("request_hedged", rid=rid, replica=j)
+            self.replicas[j].submit(att)
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def drain_replica(self, i: int, timeout_s: float = 60.0) -> None:
+        """Rolling-restart primitive: stop dispatch to replica ``i``
+        (DRAINING), let its in-flight attempts finish and be collected,
+        then restart it and return it to HEALTHY — all while the rest
+        of the fleet keeps serving.  Zero requests are failed or
+        aborted by a drain that completes within ``timeout_s`` (pinned
+        by tests/test_fleet.py); work still in flight at the timeout is
+        FAILED OVER like an eviction's — restarted underneath, it would
+        otherwise be orphaned with no terminal state."""
+        with self._cv:
+            self.health[i].start_drain("rolling restart")
+        self.observer.event("replica_draining", replica=i,
+                            reason="rolling restart")
+        deadline = time.perf_counter() + timeout_s
+        drained = False
+        while time.perf_counter() < deadline:
+            with self._cv:
+                busy = any(rep == i for fl in self._by_attempt.values()
+                           for rep in fl.live.values())
+            if not busy and self.replicas[i].idle:
+                drained = True
+                break
+            time.sleep(self.poll_s)
+        if not drained:
+            moved = self._fail_over(i, "drain timed out; restarting")
+            self.observer.event("replica_drain_timeout", replica=i,
+                                failovers=moved)
+        self.replicas[i].restart()
+        self._engine_errs[i] = None
+        self.metrics.on_restart()
+        with self._cv:
+            self.health[i].on_restarted()
+        self.observer.event("replica_restarted", replica=i)
+
+    def rolling_restart(self, timeout_s: float = 60.0) -> None:
+        """Drain+restart every replica in turn under live traffic."""
+        for i in range(len(self.replicas)):
+            self.drain_replica(i, timeout_s=timeout_s)
+
+    # ---- driving ------------------------------------------------------
+
+    def wait(self, requests: Optional[Sequence[Request]] = None,
+             timeout_s: float = 120.0) -> bool:
+        """Block until the given requests (default: everything
+        submitted) reach a terminal state; False on timeout."""
+        deadline = time.perf_counter() + timeout_s
+        with self._cv:
+            while True:
+                if requests is not None:
+                    pending = any(not r.done for r in requests)
+                else:
+                    pending = bool(self.queue or self._flights)
+                if not pending:
+                    return True
+                if time.perf_counter() >= deadline:
+                    return False
+                self._cv.wait(timeout=0.01)
+
+    def run(self, requests: Sequence[Request],
+            timeout_s: float = 120.0) -> list[Request]:
+        """Submit ``requests`` and block until all are terminal."""
+        for r in requests:
+            self.submit(r)
+        if not self.wait(requests, timeout_s=timeout_s):
+            raise TimeoutError(
+                f"fleet did not settle within {timeout_s}s "
+                f"({sum(1 for r in requests if not r.done)} pending; "
+                f"pump_error={self.pump_error})")
+        return list(requests)
+
+    # ---- shutdown -----------------------------------------------------
+
+    def shutdown(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        """``drain=True``: stop intake, let every accepted request reach
+        a terminal state, then stop replicas.  ``drain=False``: abort
+        queued and in-flight requests with a named error and tear down.
+        Idempotent; ``submit`` after shutdown rejects."""
+        with self._cv:
+            already = self._closed
+            self._closed = True
+        if already and self._stop:
+            return
+        self.observer.event("router_shutdown", drain=int(drain))
+        timed_out = False
+        if drain:
+            timed_out = not self.wait(None, timeout_s=timeout_s)
+            if timed_out:
+                self.observer.event("router_drain_timeout",
+                                    timeout_s=timeout_s)
+        if not drain or timed_out:
+            # abort (deliberate or drain-timed-out) leftovers BY NAME:
+            # an accepted request must never be left non-terminal — a
+            # caller blocking on req.done would hang forever and the
+            # accounting invariant would silently break
+            why = ("shutdown drain timed out" if timed_out
+                   else "router shut down")
+            with self._cv:
+                queued = list(self.queue)
+                self.queue.clear()
+            for fl in queued:
+                self._finish_user(
+                    fl, f"aborted: {why} before dispatch",
+                    self.metrics.on_abort)
+            for fl in list(self._flights.values()):
+                self._finish_user(fl, f"aborted: {why}",
+                                  self.metrics.on_abort)
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._pump.join(timeout=5.0)
+        for rep in self.replicas:
+            rep.stop(drain=drain)
+        self._collect()    # pump is gone: settle the last completions
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        self.shutdown(drain=exc_type is None)
+        return False
+
+    # ---- reporting ----------------------------------------------------
+
+    def summary(self) -> dict:
+        """Fleet-level metrics with per-replica summaries nested under
+        ``replicas`` (call after :meth:`wait` / :meth:`shutdown` so the
+        harvest-side numbers are settled)."""
+        return self.metrics.summary(
+            [rep.metrics.summary() for rep in self.replicas],
+            health=[h.state for h in self.health])

@@ -112,9 +112,11 @@ class ServeMetrics:
 
     def on_abort(self, req):
         """Aborted by shutdown — queued-but-unadmitted, or in flight at
-        a non-draining shutdown.  A deliberate abort of an ALREADY
-        SUBMITTED request: counted separately so ``requests_failed``
-        stays an engine-health signal and ``requests_submitted`` (which
+        a non-draining shutdown — or cancelled by rid
+        (:meth:`Scheduler.cancel`, e.g. the fleet Router's hedge-loser
+        path).  A deliberate abort of an ALREADY SUBMITTED request:
+        counted separately so ``requests_failed`` stays an
+        engine-health signal and ``requests_submitted`` (which
         ``on_submit`` already incremented) is not double-counted."""
         self.n_aborted += 1
 

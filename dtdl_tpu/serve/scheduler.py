@@ -106,9 +106,22 @@ class Request:
     decode); ``error`` is set instead of raising when the scheduler
     rejects the request at submit (e.g. prompt longer than the engine's
     largest prefill bucket, admission queue full, scheduler shut down),
-    expires it past its ``deadline_s`` (wall-clock budget from submit;
-    None = no deadline), or fails it during engine containment — one
-    bad request never crashes a run with others in flight.
+    expires it past its deadline, or fails it during engine containment
+    — one bad request never crashes a run with others in flight.
+    ``error`` always starts with the terminal kind — ``rejected:`` /
+    ``expired:`` / ``failed:`` / ``aborted:`` / ``shed:`` — so callers
+    (the fleet Router above all) can branch on the flavor without
+    parsing prose.
+
+    Deadlines come in two spellings: ``deadline_s`` is a wall-clock
+    budget *from this scheduler's submit* (the PR 5 semantics), while
+    ``deadline_at`` is an **absolute** ``time.perf_counter()`` instant.
+    A front queue (the fleet Router) sets ``deadline_at`` once at *its*
+    intake, so time spent queued ahead of the scheduler counts against
+    the budget — without it a request could wait out its whole
+    allowance in a router queue and still get a fresh one at the
+    engine.  When only ``deadline_s`` is given, ``submit`` derives
+    ``deadline_at = t_submit + deadline_s``.
     """
     prompt: Sequence[int]
     max_new_tokens: int
@@ -116,6 +129,7 @@ class Request:
     eos_id: Optional[int] = None
     speculate: int = 0
     deadline_s: Optional[float] = None
+    deadline_at: Optional[float] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
@@ -139,6 +153,18 @@ class Request:
         if self.speculate < 0:
             raise ValueError(f"speculate must be >= 0, got "
                              f"{self.speculate}")
+
+    def __repr__(self):
+        # the dataclass default would dump the whole prompt and token
+        # list — useless in a log line and unreadable for the fleet's
+        # per-attempt diagnostics.  One compact line: identity, sizes,
+        # lifecycle state, and the error if any.
+        state = ("pending" if not self.done
+                 else "error" if self.error else "done")
+        err = f", error={self.error!r}" if self.error else ""
+        return (f"Request(rid={self.rid}, prompt_len={len(self.prompt)}, "
+                f"max_new_tokens={self.max_new_tokens}, "
+                f"tokens={len(self.tokens)}, {state}{err})")
 
 
 class _SlotState:
@@ -261,6 +287,7 @@ class Scheduler:
         # the blast radius of an engine failure (see step()/shutdown())
         self.max_queue = max_queue
         self._closed = False
+        self._containing = False
         self.last_engine_error: Optional[str] = None
         # watchdog early-out: stays False until a deadline-carrying
         # request is submitted, so the per-step queue/slot scan is free
@@ -284,13 +311,19 @@ class Scheduler:
 
     # ---- intake -------------------------------------------------------
 
+    _ERROR_KINDS = ("rejected", "expired", "failed", "aborted", "shed")
+
     def _finish_error(self, req: Request, reason: str,
-                      metric_hook) -> Request:
-        """The one terminal-error path: ``req.error`` set, request
-        finished, the given metrics hook (on_reject / on_expire /
-        on_failure / on_abort) counts it — every containment branch
-        funnels through here so retirement bookkeeping cannot drift."""
-        req.error = reason
+                      metric_hook, kind: str) -> Request:
+        """The one terminal-error path: ``req.error`` set to
+        ``"<kind>: <reason>"`` (kind ∈ rejected / expired / failed /
+        aborted / shed — the machine-checkable flavor a caller branches
+        on), request finished, the given metrics hook (on_reject /
+        on_expire / on_failure / on_abort / on_shed) counts it — every
+        containment branch funnels through here so retirement
+        bookkeeping and message format cannot drift."""
+        assert kind in self._ERROR_KINDS, kind
+        req.error = f"{kind}: {reason}"
         req.done = True
         req.t_done = time.perf_counter()
         self.finished.append(req)
@@ -302,7 +335,8 @@ class Scheduler:
         run unharmed — the named-error-instead-of-crash path shared by
         oversized prompts, a full admission queue, and shutdown."""
         self._reqs[req.rid] = req
-        return self._finish_error(req, reason, self.metrics.on_reject)
+        return self._finish_error(req, reason, self.metrics.on_reject,
+                                  "rejected")
 
     def submit(self, req: Request) -> Request:
         """Enqueue ``req``; a request the scheduler cannot serve comes
@@ -319,6 +353,13 @@ class Scheduler:
         req.t_submit = time.perf_counter()
         if self._closed:
             return self._reject(req, "scheduler is shut down")
+        if self._containing:
+            # a thread-hosted scheduler (the fleet Replica) can receive
+            # a submit while _contain is mid-flight on the worker —
+            # admitting into an arena being re-initialized would race;
+            # the same named-reason rejection path applies (retryable)
+            return self._reject(
+                req, "engine containment in progress; retry shortly")
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             return self._reject(
                 req, f"admission queue full ({self.max_queue} waiting); "
@@ -338,7 +379,10 @@ class Scheduler:
                     req, f"page pool exhausted: prompt needs {need} "
                          f"pages (page_size={pg}) but the pool has "
                          f"only {self.pages.capacity}")
-        if req.deadline_s is not None:
+        if req.deadline_at is None and req.deadline_s is not None:
+            # the PR 5 relative spelling: budget starts at THIS submit
+            req.deadline_at = req.t_submit + req.deadline_s
+        if req.deadline_at is not None:
             self._deadlines_seen = True
         self._reqs[req.rid] = req
         self.queue.append(req)
@@ -389,23 +433,83 @@ class Scheduler:
         now = time.perf_counter()
 
         def expired(req):
-            return (req.deadline_s is not None
-                    and now - req.t_submit >= req.deadline_s)
+            # deadline_at is the single source of truth (submit derives
+            # it from deadline_s) — absolute, so front-queue time spent
+            # before this scheduler's submit counts against the budget
+            return req.deadline_at is not None and now >= req.deadline_at
+
+        def budget(req):
+            return (f"{req.deadline_s}s" if req.deadline_s is not None
+                    else f"(absolute, {req.deadline_at - req.t_submit:+.3f}"
+                         f"s from submit)")
 
         for req in [r for r in self.queue if expired(r)]:
             self.queue.remove(req)
             self._finish_error(
-                req, f"deadline {req.deadline_s}s exceeded before "
-                     f"admission", self.metrics.on_expire)
+                req, f"deadline {budget(req)} exceeded before "
+                     f"admission", self.metrics.on_expire, "expired")
             self.observer.event("request_expired", rid=req.rid, queued=1)
         for slot, req in enumerate(self.slots):
             if req is None or not self._active[slot] or not expired(req):
                 continue
             self._finish_error(
-                req, f"deadline {req.deadline_s}s exceeded after "
-                     f"{len(req.tokens)} tokens", self.metrics.on_expire)
+                req, f"deadline {budget(req)} exceeded after "
+                     f"{len(req.tokens)} tokens", self.metrics.on_expire,
+                "expired")
             self.observer.event("request_expired", rid=req.rid, slot=slot)
             self._retire(slot)
+
+    # ---- router-facing hooks (dtdl_tpu/serve/fleet.py) ----------------
+
+    @property
+    def load(self) -> int:
+        """Host-side occupancy signal for least-loaded routing: queued
+        plus actively decoding requests.  A plain int read — safe to
+        sample from another thread without stopping the step loop."""
+        return len(self.queue) + int(self._active.sum())
+
+    def pending_requests(self) -> list:
+        """Every submitted-but-unfinished request (queued, slotted, or
+        retired-awaiting-harvest) — the outstanding-work export for a
+        fleet/ops layer.  (The shipped Router re-dispatches an evicted
+        replica's work from its OWN attempt table — it never trusts a
+        possibly-wedged replica's bookkeeping — so this is the
+        inspection surface, e.g. for drain monitoring, not the failover
+        mechanism.)"""
+        return [r for r in self._reqs.values() if not r.done]
+
+    def cancel(self, rid: int, reason: str = "cancelled") -> bool:
+        """Best-effort cancellation of one request by id: a queued
+        request is removed, an in-slot one retires — both finish with
+        ``error = "aborted: cancelled ..."`` and count under
+        ``requests_aborted`` (a deliberate abort of an already-submitted
+        request, exactly the shutdown-abort semantics, so the PR 5
+        accounting invariant holds unchanged).  Returns False when it is
+        too late to matter: unknown rid, already finished, or already
+        retired on guaranteed budget with its tokens merely awaiting the
+        lag harvest (those are computed — the harvest delivers them; a
+        caller that must not double-deliver, e.g. the Router's hedge
+        loser path, discards the completion instead)."""
+        req = self._reqs.get(rid)
+        if req is None or req.done:
+            return False
+        if req in self.queue:
+            self.queue.remove(req)
+            self._finish_error(
+                req, f"cancelled before admission: {reason}",
+                self.metrics.on_abort, "aborted")
+            self.observer.event("request_cancelled", rid=rid, queued=1)
+            return True
+        for slot, r in enumerate(self.slots):
+            if r is req:
+                self._finish_error(
+                    req, f"cancelled after {len(req.tokens)} tokens: "
+                         f"{reason}", self.metrics.on_abort, "aborted")
+                self.observer.event("request_cancelled", rid=rid,
+                                    slot=slot)
+                self._retire(slot)
+                return True
+        return False     # retired-awaiting-harvest: let it finish
 
     def _contain(self, exc: BaseException):
         """Engine-failure blast radius: the in-flight batch.
@@ -422,37 +526,42 @@ class Scheduler:
         could not settle is error-finished like the slotted ones.  The
         admission queue survives — the next step admits and serves it
         against the fresh arena."""
-        self.last_engine_error = f"{type(exc).__name__}: {exc}"
-        self.observer.event("engine_failure", error=self.last_engine_error)
-        pending_rids = {rid for _, _, entries in self._pending
-                        for _, rid, _ in entries}
+        self._containing = True
         try:
-            while self._pending:
-                self._harvest_one()
-        except Exception:          # device state unusable — drop the rest
-            self._pending.clear()
-        for slot, req in enumerate(self.slots):
-            if req is None:
-                continue
-            self._finish_error(
-                req, f"engine failure: {self.last_engine_error}",
-                self.metrics.on_failure)
-            self._retire(slot)
-            self._state[slot] = None
-        for rid in pending_rids:   # retired-for-budget but unharvested
-            req = self._reqs[rid]
-            if not req.done:
+            self.last_engine_error = f"{type(exc).__name__}: {exc}"
+            self.observer.event("engine_failure",
+                                error=self.last_engine_error)
+            pending_rids = {rid for _, _, entries in self._pending
+                            for _, rid, _ in entries}
+            try:
+                while self._pending:
+                    self._harvest_one()
+            except Exception:      # device state unusable — drop the rest
+                self._pending.clear()
+            for slot, req in enumerate(self.slots):
+                if req is None:
+                    continue
                 self._finish_error(
                     req, f"engine failure: {self.last_engine_error}",
-                    self.metrics.on_failure)
-        self.arena = self.engine.init_arena()
-        self.last_tokens = self.engine.init_last_tokens()
-        if self.pages is not None:
-            # the re-initialized arena invalidated every page's
-            # contents — a stale prefix hit would be silent corruption
-            self.pages.reset()
-            self._ptab[:] = GARBAGE_PAGE
-            self._slot_pages = [[] for _ in range(self.engine.n_slots)]
+                    self.metrics.on_failure, "failed")
+                self._retire(slot)
+                self._state[slot] = None
+            for rid in pending_rids:  # retired-for-budget but unharvested
+                req = self._reqs[rid]
+                if not req.done:
+                    self._finish_error(
+                        req, f"engine failure: {self.last_engine_error}",
+                        self.metrics.on_failure, "failed")
+            self.arena = self.engine.init_arena()
+            self.last_tokens = self.engine.init_last_tokens()
+            if self.pages is not None:
+                # the re-initialized arena invalidated every page's
+                # contents — a stale prefix hit would be silent corruption
+                self.pages.reset()
+                self._ptab[:] = GARBAGE_PAGE
+                self._slot_pages = [[] for _ in range(self.engine.n_slots)]
+        finally:
+            self._containing = False
 
     def _admit(self):
         if self._closed:
@@ -516,7 +625,7 @@ class Scheduler:
                 self._contain(e)
                 self._finish_error(
                     req, f"engine failure: {self.last_engine_error}",
-                    self.metrics.on_failure)
+                    self.metrics.on_failure, "failed")
                 return
             if self.pages is not None:
                 self._ptab[slot] = row
@@ -589,7 +698,7 @@ class Scheduler:
             except PagePoolExhaustedError as e:
                 self._finish_error(
                     req, f"{e} (shed after {len(req.tokens)} harvested "
-                         f"tokens)", self.metrics.on_shed)
+                         f"tokens)", self.metrics.on_shed, "shed")
                 self.observer.event("page_pool_shed", rid=req.rid,
                                     slot=slot)
                 self._retire(slot)
@@ -816,7 +925,7 @@ class Scheduler:
             # expired+failed+aborted invariant
             self._finish_error(self.queue.popleft(),
                                "scheduler shut down before admission",
-                               self.metrics.on_abort)
+                               self.metrics.on_abort, "aborted")
         if already:
             return
         self.observer.event("scheduler_shutdown", drain=int(drain))
@@ -832,7 +941,7 @@ class Scheduler:
             # a deliberate abort, not an engine failure: counted under
             # requests_aborted so the failure alert stays meaningful
             self._finish_error(req, "scheduler shut down",
-                               self.metrics.on_abort)
+                               self.metrics.on_abort, "aborted")
             self._retire(slot)
 
     def __enter__(self) -> "Scheduler":

@@ -253,7 +253,7 @@ _HELP_SCRIPTS = [
     "mnist_multi_worker_strategy.py", "train_mnist.py", "train_mnist_gpu.py",
     "train_mnist_multi.py", "mxnet_kvstore.py", "caffe_train.py",
     "tf_estimator.py", "train_lm.py", "train_lm_4d.py",
-    "train_lm_gspmd.py", "imagenet_resnet50.py",
+    "train_lm_gspmd.py", "imagenet_resnet50.py", "serve_fleet.py",
 ]
 
 
@@ -327,3 +327,17 @@ def test_serve_lm_example():
         "--max-new-tokens", "6", "--harvest-lag", "2")
     assert re.search(r"served 5 requests", out), out
     assert "'decode': 1" in out, out
+
+
+@pytest.mark.slow
+@pytest.mark.fleet
+def test_serve_fleet_example_kill_replica():
+    """Fleet example end-to-end with the live-failover flag: replica 0
+    dies mid-traffic, every request still finishes, nothing is lost
+    (compile-heavy -> slow; fast fleet coverage in tests/test_fleet.py)."""
+    out = run_example(
+        "serve_fleet.py", "--n-requests", "10", "--n-slots", "2",
+        "--max-new-tokens", "8", "--kill-replica-after", "4")
+    assert re.search(r"served 10/10 requests", out), out
+    assert "evicted replica 0" in out, out
+    assert re.search(r"\[OK\]\s+requests lost: 0", out), out

@@ -1,0 +1,599 @@
+"""Serving fleet: Router/Replica/health state machine pins (ISSUE 9).
+
+The contracts, on one shared tiny f32 engine (replicas share compiled
+programs — the CPU-testable construction, and the reason the failover
+oracle below is exact):
+
+* **failover oracle** — with deterministic fault injection killing one
+  replica mid-flight, every accepted greedy request completes
+  token-identical to a fault-free single-replica run (or carries a
+  named error once its retry budget is exhausted), and the fleet-level
+  ``submitted == finished + rejected + expired + failed + aborted``
+  invariant holds with retries counted once — the e2e acceptance
+  scenario;
+* **state machine** — every HEALTHY → SUSPECT → EVICTED → DRAINING →
+  HEALTHY edge driven by injected probe/containment signals, with the
+  circuit breaker (SUSPECT blocks dispatch) strictly before eviction;
+* **rolling restart** — drain+restart of one replica under continuous
+  traffic completes with zero failed/aborted requests and no dispatch
+  to a DRAINING/EVICTED replica;
+* **hedging** — first completion wins, exactly-once delivery;
+* the PR 9 scheduler satellites: absolute deadlines (router queue time
+  counts), ``cancel``, the containment submit guard, kind-prefixed
+  ``req.error`` formats, and the concise ``Request.__repr__``.
+"""
+
+import re
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtdl_tpu.models.transformer import transformer_lm
+from dtdl_tpu.resil import FaultPlan
+from dtdl_tpu.resil.faults import replica_site
+from dtdl_tpu.serve import (DRAINING, EVICTED, HEALTHY, SUSPECT,
+                            InferenceEngine, ReplicaHealth, Request,
+                            Router, Scheduler)
+
+MAX_SEQ = 32
+N_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def model():
+    return transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, attn_impl="dense", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    params = nn.unbox(model.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 4), jnp.int32))["params"])
+    return InferenceEngine(model, params, n_slots=2, buckets=(8,))
+
+
+def mk_prompts(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 64, int(rng.integers(3, 8))).tolist()
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def oracle(engine):
+    """Fault-free single-replica greedy reference (also warms the
+    compiled programs, so the threaded tests below never hold a worker
+    inside a multi-second first compile)."""
+    prompts = mk_prompts(6)
+    refs = [Request(list(p), N_NEW) for p in prompts]
+    Scheduler(engine, harvest_lag=1).run(refs)
+    return prompts, [r.tokens for r in refs]
+
+
+def kw(**over):
+    """Fast, deterministic-enough Router knobs for a test box."""
+    base = dict(sched_kwargs={"harvest_lag": 1}, retry_budget=3,
+                probe_interval_s=0.01, watchdog_s=0.25)
+    base.update(over)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# the health state machine (pure unit — every edge injected directly)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_health_circuit_breaks_before_eviction():
+    """A failure signal opens the circuit (SUSPECT: not dispatchable)
+    STRICTLY before eviction; more signals while suspect evict."""
+    h = ReplicaHealth(suspect_after=1, evict_after=2)
+    assert h.state == HEALTHY and h.dispatchable
+    assert h.on_signal("containment") == SUSPECT
+    assert not h.dispatchable           # circuit open, replica NOT dead
+    assert h.on_signal("again") == SUSPECT
+    assert h.on_signal("third") == EVICTED
+    assert not h.dispatchable
+    # the recorded path never skips SUSPECT
+    assert [(a, b) for _, a, b, _ in h.transitions] == \
+        [(HEALTHY, SUSPECT), (SUSPECT, EVICTED)]
+
+
+@pytest.mark.fleet
+def test_health_probe_recovery_closes_circuit():
+    h = ReplicaHealth(suspect_after=1, evict_after=3, recover_after=2)
+    h.on_signal("transient hiccup")
+    assert h.state == SUSPECT
+    assert h.on_probe(True) == SUSPECT      # one clean probe: not yet
+    assert h.on_probe(True) == HEALTHY      # two: circuit closes
+    assert h.dispatchable and h.fail_streak == 0
+    # a clean completion resets the streak so suspect_after counts
+    # CONSECUTIVE failures
+    h2 = ReplicaHealth(suspect_after=2, evict_after=2)
+    h2.on_signal("one")
+    h2.on_success()
+    h2.on_signal("one again")
+    assert h2.state == HEALTHY              # never two in a row
+
+
+@pytest.mark.fleet
+def test_health_probe_failures_evict_and_full_cycle():
+    """Probe blackholes walk HEALTHY→SUSPECT→EVICTED; the lifecycle
+    replace walks EVICTED→DRAINING→HEALTHY — the full ISSUE-9 cycle."""
+    h = ReplicaHealth(suspect_after=1, evict_after=2, recover_after=2)
+    assert h.on_probe(False) == SUSPECT         # circuit opens first...
+    assert h.on_probe(False) == SUSPECT         # ...and eviction needs
+    assert h.on_probe(False) == EVICTED         # evict_after MORE fails
+    assert h.on_signal("too late") == EVICTED   # absorbing
+    assert h.on_probe(True) == EVICTED          # probes cannot resurrect
+    assert h.start_drain("replace") == DRAINING
+    assert not h.dispatchable
+    assert h.on_signal("ignored while draining") == DRAINING
+    assert h.on_restarted() == HEALTHY and h.dispatchable
+    assert [(a, b) for _, a, b, _ in h.transitions] == [
+        (HEALTHY, SUSPECT), (SUSPECT, EVICTED),
+        (EVICTED, DRAINING), (DRAINING, HEALTHY)]
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_router_single_replica_token_identity(engine, oracle):
+    prompts, want = oracle
+    with Router(engine, n_replicas=1, **kw()) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+    for r, toks in zip(reqs, want):
+        assert r.done and r.error is None
+        assert r.tokens == toks
+    s = router.summary()
+    assert s["fleet_accounting_ok"] and s["fleet_requests_finished"] == 6
+    assert router.pump_error is None
+
+
+@pytest.mark.fleet
+def test_router_two_replicas_least_loaded_and_identical(engine, oracle):
+    prompts, want = oracle
+    with Router(engine, n_replicas=2, **kw()) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        served = sorted({e[1] for e in router.dispatch_log})
+    for r, toks in zip(reqs, want):
+        assert r.error is None and r.tokens == toks
+    # least-loaded routing must spread 6 requests over both replicas
+    assert served == [0, 1]
+    assert router.summary()["fleet_accounting_ok"]
+
+
+# ---------------------------------------------------------------------------
+# failover — THE e2e acceptance scenario
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_failover_oracle_e2e(engine, oracle):
+    """E2E acceptance: replica 0's engine dies on every compiled-program
+    call (deterministic injection mid-flight).  Every accepted greedy
+    request must complete TOKEN-IDENTICAL to the fault-free
+    single-replica oracle, the replica must leave HEALTHY through the
+    circuit breaker, and the fleet-level accounting invariant must hold
+    with retried requests counted exactly once."""
+    prompts, want = oracle
+    plan = FaultPlan()
+    for k in range(50):
+        plan.at(replica_site(0, "engine"), k)
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=False,
+                **kw(recover_after=50)) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        s = router.summary()
+        h0 = router.health[0]
+    # the oracle: failover is invisible in the tokens
+    for r, toks in zip(reqs, want):
+        assert r.done and r.error is None, r
+        assert r.tokens == toks, f"{r} diverged after failover"
+    # at least one attempt died on replica 0 and was re-dispatched
+    assert s["fleet_retries"] >= 1
+    # circuit opened (and may have escalated to eviction if several
+    # attempts were in flight when the engine died — both end states
+    # are reached only THROUGH suspect, never by skipping it)
+    assert h0.state in (SUSPECT, EVICTED)
+    assert h0.transitions[0][1:3] == (HEALTHY, SUSPECT)
+    # the fleet invariant, retries counted once: 6 submitted user
+    # requests, 6 finished, zero in every other terminal ledger
+    assert s["fleet_requests_submitted"] == 6
+    assert s["fleet_requests_finished"] == 6
+    assert (s["fleet_requests_rejected"] == s["fleet_requests_expired"]
+            == s["fleet_requests_failed"] == s["fleet_requests_aborted"]
+            == 0)
+    assert s["fleet_accounting_ok"]
+    assert router.pump_error is None
+
+
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_worker_death_evicts_fails_over_and_refills(engine, oracle):
+    """A dead worker thread (loop-site raise) is detected passively
+    (heartbeat stops), the probe confirms, the replica walks
+    SUSPECT→EVICTED, its in-flight attempts fail over losslessly, and
+    auto_restart refills it through DRAINING back to HEALTHY."""
+    prompts, want = oracle
+    plan = FaultPlan().at(replica_site(0, "loop"), 0)
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=True,
+                **kw(watchdog_s=0.15)) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        s = router.summary()
+        trans = [(a, b) for _, a, b, _ in router.health[0].transitions]
+    for r, toks in zip(reqs, want):
+        assert r.error is None and r.tokens == toks
+    assert s["fleet_evictions"] == 1
+    assert s["fleet_failovers"] >= 1
+    assert s["fleet_restarts"] == 1
+    assert s["replica_health"] == [HEALTHY, HEALTHY]
+    assert trans == [(HEALTHY, SUSPECT), (SUSPECT, EVICTED),
+                     (EVICTED, DRAINING), (DRAINING, HEALTHY)]
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.slow
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_harvest_stall_trips_watchdog(engine, oracle):
+    """A frozen worker (loop-site stall with work outstanding) stops
+    heart-beating; the watchdog raises the stall signal, the wedged
+    replica is evicted, and traffic completes elsewhere."""
+    prompts, want = oracle
+    plan = FaultPlan().at(replica_site(0, "loop"), 0, kind="stall",
+                          seconds=0.8)
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=True,
+                **kw(watchdog_s=0.1, probe_interval_s=0.02)) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts],
+                          timeout_s=30)
+        s = router.summary()
+        reasons = " | ".join(
+            c for _, _, _, c in router.health[0].transitions)
+    for r, toks in zip(reqs, want):
+        assert r.error is None and r.tokens == toks
+    assert s["fleet_evictions"] == 1
+    assert "stall" in reasons or "probe" in reasons
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.slow
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_retry_budget_exhausted_is_named_failure(engine, oracle):
+    """When every replica's engine is dead, requests exhaust their
+    retry budget and fail with the named ``failed:`` error — and the
+    invariant still holds (failed counted, nothing lost)."""
+    prompts, _ = oracle
+    plan = FaultPlan()
+    for i in (0, 1):
+        for k in range(200):
+            plan.at(replica_site(i, "engine"), k)
+    # evict_after high: replicas flap HEALTHY↔SUSPECT but stay in the
+    # fleet, so every request deterministically BURNS its budget rather
+    # than racing the all-evicted path (tested separately below)
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=False,
+                **kw(probe_interval_s=0.005, recover_after=1,
+                     evict_after=100, retry_budget=1)) as router:
+        reqs = router.run([Request(list(p), N_NEW)
+                           for p in prompts[:2]], timeout_s=60)
+        s = router.summary()
+    for r in reqs:
+        assert r.done and r.error is not None
+        assert r.error.startswith("failed:")
+        assert "retry budget" in r.error
+    assert s["fleet_requests_failed"] == 2
+    assert s["fleet_requests_finished"] == 0
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.slow
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_all_replicas_evicted_fails_by_name(engine, oracle):
+    """Total fleet death (every worker dead, no auto-restart): queued
+    requests must fail with a named error, never hang."""
+    prompts, _ = oracle
+    plan = FaultPlan()
+    for i in (0, 1):
+        plan.at(replica_site(i, "loop"), 0)
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=False,
+                **kw(watchdog_s=0.1, probe_interval_s=0.01)) as router:
+        reqs = router.run([Request(list(p), N_NEW)
+                           for p in prompts[:3]], timeout_s=60)
+        s = router.summary()
+    for r in reqs:
+        assert r.done and r.error is not None
+        assert r.error.startswith("failed:"), r
+    assert s["replica_health"] == [EVICTED, EVICTED]
+    assert s["fleet_requests_failed"] == 3
+    assert s["fleet_accounting_ok"]
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_hedge_first_completion_wins_exactly_once(engine, oracle):
+    """hedge_after_s=0 hedges every request to the second replica; the
+    first completion wins, the loser is cancelled (or its late
+    completion dropped), and delivery is exactly-once: every request
+    carries exactly the oracle's tokens, never a double append."""
+    prompts, want = oracle
+    with Router(engine, n_replicas=2, hedge_after_s=0.0,
+                **kw()) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        s = router.summary()
+    for r, toks in zip(reqs, want):
+        assert r.error is None
+        assert r.tokens == toks            # exactly-once, token-exact
+    assert s["fleet_hedges"] >= 1
+    assert s["fleet_hedges_won"] <= s["fleet_hedges"]
+    # hedge attempts must never double a terminal ledger entry
+    assert s["fleet_requests_finished"] == 6
+    assert s["fleet_accounting_ok"]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: rolling restart
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_rolling_restart_zero_failures_no_draining_dispatch(engine,
+                                                            oracle):
+    """Drain+restart of each replica under continuous traffic: zero
+    failed/aborted requests, and the dispatch log shows no dispatch
+    into a replica between its DRAINING and HEALTHY transition
+    timestamps."""
+    prompts, want = oracle
+    with Router(engine, n_replicas=2, **kw()) as router:
+        reqs = [Request(list(p), N_NEW) for p in prompts * 2]
+        for r in reqs:
+            router.submit(r)
+        router.rolling_restart(timeout_s=30)
+        assert router.wait(reqs, timeout_s=60)
+        s = router.summary()
+        log = list(router.dispatch_log)
+        windows = []
+        for i, h in enumerate(router.health):
+            t_drain = next(t for t, _, b, _ in h.transitions
+                           if b == DRAINING)
+            t_back = next(t for t, _, b, _ in h.transitions
+                          if b == HEALTHY)
+            windows.append((i, t_drain, t_back))
+    assert s["fleet_requests_failed"] == 0
+    assert s["fleet_requests_aborted"] == 0
+    assert s["fleet_requests_finished"] == len(reqs)
+    assert s["fleet_restarts"] == 2
+    for r, toks in zip(reqs, want * 2):
+        assert r.error is None and r.tokens == toks
+    for i, t_drain, t_back in windows:
+        inside = [e for e in log if e[1] == i and t_drain <= e[0] <= t_back]
+        assert not inside, f"dispatched into draining replica {i}: {inside}"
+    assert s["fleet_accounting_ok"]
+
+
+# ---------------------------------------------------------------------------
+# admission: bounded queue, shutdown, deadlines through the router queue
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_router_bounded_queue_and_shutdown_reject(engine, oracle):
+    prompts, _ = oracle
+    router = Router(engine, n_replicas=1, max_queue=1,
+                    **kw(poll_s=0.05, probe_interval_s=1.0))
+    try:
+        # the pump wakes at most every 50ms here, so these three land in
+        # the router queue together: 1 accepted, 2 shed by name
+        rs = [router.submit(Request(list(prompts[i % len(prompts)]),
+                                    N_NEW)) for i in range(3)]
+        shed = [r for r in rs if r.done and r.error]
+        assert len(shed) >= 1
+        for r in shed:
+            assert r.error.startswith("rejected:")
+            assert "admission queue full" in r.error
+        router.wait([r for r in rs if r.error is None], timeout_s=60)
+    finally:
+        router.shutdown()
+    late = router.submit(Request(list(prompts[0]), N_NEW))
+    assert late.done and late.error.startswith("rejected:")
+    assert "shut down" in late.error
+    assert router.summary()["fleet_accounting_ok"]
+
+
+@pytest.mark.fleet
+def test_router_capacity_gate_and_load_backpressure(engine, oracle):
+    """Dispatch holds each replica at <= 2x its slot count, so backlog
+    stays in the ROUTER queue (where max_queue can shed it), and a
+    replica-side 'queue full' rejection is backpressure, not failure:
+    it requeues WITHOUT burning the retry budget — retry_budget=0 here,
+    so any burn would terminally fail a request."""
+    prompts, want = oracle
+    with pytest.raises(ValueError):
+        Router(engine, n_replicas=0)
+    with Router(engine, n_replicas=1, retry_budget=0,
+                sched_kwargs={"harvest_lag": 1, "max_queue": 1},
+                probe_interval_s=0.01, watchdog_s=0.25) as router:
+        reqs = router.run([Request(list(prompts[i % 6]), N_NEW)
+                           for i in range(8)], timeout_s=60)
+        s = router.summary()
+        h = router.health[0]
+    for i, r in enumerate(reqs):
+        assert r.error is None, r
+        assert r.tokens == want[i % 6]
+    assert s["fleet_retries"] == 0          # backpressure burned nothing
+    assert s["fleet_requests_failed"] == 0
+    assert h.state == HEALTHY               # ...and sickened nothing
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.fleet
+def test_deadline_counts_router_queue_time(engine, oracle):
+    """A request whose deadline elapses while still in the ROUTER queue
+    expires with the named error — the budget is global, not reset at
+    the replica (satellite: absolute deadlines)."""
+    prompts, _ = oracle
+    with Router(engine, n_replicas=1, **kw()) as router:
+        # deadline already in the past at submit: can never dispatch
+        dead = router.submit(Request(list(prompts[0]), N_NEW,
+                                     deadline_s=0.0))
+        live = router.submit(Request(list(prompts[1]), N_NEW))
+        router.wait([dead, live], timeout_s=60)
+        s = router.summary()
+    assert dead.done and dead.error.startswith("expired:")
+    assert "deadline" in dead.error
+    assert live.error is None and len(live.tokens) == N_NEW
+    assert s["fleet_requests_expired"] == 1
+    assert s["fleet_accounting_ok"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler satellites (no threads)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_absolute_deadline(engine):
+    """deadline_at is absolute: already-elapsed time (e.g. spent in a
+    front queue) counts, and deadline_s derives deadline_at at submit."""
+    sched = Scheduler(engine, harvest_lag=1)
+    past = Request(mk_prompts(1, seed=9)[0], N_NEW,
+                   deadline_at=time.perf_counter() - 0.1)
+    sched.submit(past)
+    sched.step()
+    sched.drain()
+    assert past.done and past.error.startswith("expired:")
+    assert "deadline" in past.error and not past.tokens
+    rel = Request(mk_prompts(1, seed=10)[0], N_NEW, deadline_s=30.0)
+    sched.submit(rel)
+    assert rel.deadline_at is not None
+    assert abs(rel.deadline_at - rel.t_submit - 30.0) < 1e-6
+    sched.run()
+    assert rel.error is None
+
+
+def test_scheduler_cancel_queued_and_inflight(engine):
+    """cancel() retires by rid with the aborted flavor, queued or
+    in-slot, and the per-scheduler accounting invariant holds."""
+    sched = Scheduler(engine, harvest_lag=1)
+    reqs = [sched.submit(Request(p, 8))
+            for p in mk_prompts(4, seed=11)]
+    sched.step()                       # two admitted, two queued
+    assert sorted(r.rid for r in sched.pending_requests()) == \
+        sorted(r.rid for r in reqs)    # the outstanding-work export
+    queued = next(r for r in reqs if r in sched.queue)
+    slotted = next(r for r in sched.slots if r is not None)
+    assert sched.cancel(queued.rid, "test says so")
+    assert queued.done and queued.error.startswith("aborted:")
+    assert "cancelled" in queued.error and "test says so" in queued.error
+    assert sched.cancel(slotted.rid)
+    assert slotted.error.startswith("aborted:")
+    assert not sched.cancel(slotted.rid)      # idempotent: too late
+    assert not sched.cancel(10 ** 9)          # unknown rid
+    sched.run()
+    s = sched.metrics.summary()
+    assert s["requests_aborted"] == 2
+    assert s["requests_submitted"] == (
+        s["requests_finished"] + s["requests_rejected"]
+        + s["requests_expired"] + s["requests_failed"]
+        + s["requests_aborted"])
+
+
+def test_scheduler_submit_mid_contain_rejects(engine):
+    """submit during _contain (a thread-hosted replica race) surfaces
+    the same named-reason rejection path, and the guard clears."""
+    sched = Scheduler(engine, harvest_lag=1)
+    sched._containing = True
+    r = sched.submit(Request(mk_prompts(1, seed=12)[0], N_NEW))
+    assert r.done and r.error.startswith("rejected:")
+    assert "containment" in r.error
+    sched._containing = False
+    # a real containment clears the flag on the way out
+    victim = sched.submit(Request(mk_prompts(1, seed=13)[0], N_NEW))
+    sched.step()
+    orig = sched.engine.decode
+    try:
+        sched.engine.decode = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        sched.step()
+    finally:
+        sched.engine.decode = orig
+    assert victim.error.startswith("failed:")
+    assert not sched._containing
+    ok = sched.submit(Request(mk_prompts(1, seed=14)[0], N_NEW))
+    assert ok.error is None
+    sched.run()
+    assert ok.done and ok.error is None
+
+
+def test_error_kinds_consistent_and_repr(engine):
+    """Every terminal req.error starts with its machine-checkable kind,
+    and Request.__repr__ is one compact line (no prompt dump)."""
+    pat = re.compile(r"^(rejected|expired|failed|aborted|shed): ")
+    errors = []
+    sched = Scheduler(engine, harvest_lag=1, max_queue=1)
+    long_prompt = list(range(20))     # past the largest (8) bucket
+    errors.append(sched.submit(Request(long_prompt, 4)).error)
+    sched.submit(Request(mk_prompts(1, seed=15)[0], 4))
+    errors.append(                    # queue full
+        sched.submit(Request(mk_prompts(1, seed=16)[0], 4)).error)
+    errors.append(sched.submit(      # pre-expired deadline
+        Request(mk_prompts(1, seed=17)[0], 4,
+                deadline_at=time.perf_counter() - 1)).error or "")
+    sched.shutdown(drain=False)
+    errors.append(                    # post-shutdown submit
+        sched.submit(Request(mk_prompts(1, seed=18)[0], 4)).error)
+    # deadline expiry message (drain resolved it above or at shutdown):
+    errors = [e for e in errors if e]
+    for e in errors:
+        assert pat.match(e), f"unprefixed error: {e!r}"
+    # repr: compact, informative, no token dump
+    r = Request(list(range(30)) + [7] * 40, 5)
+    r.tokens = [1, 2, 3]
+    rep = repr(r)
+    assert f"rid={r.rid}" in rep and "prompt_len=70" in rep
+    assert "tokens=3" in rep and "pending" in rep
+    assert "7, 7, 7" not in rep
+    r.done, r.error = True, "failed: engine failure: x"
+    assert "error" in repr(r)
+    assert len(repr(r)) < 200
+
+
+# ---------------------------------------------------------------------------
+# the soak (slow): sustained traffic + faults + rolling restart
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_soak_faults_and_rolling_restart(engine, oracle):
+    """The long scenario: 36 requests, replica 0's engine failing on
+    chosen calls, a rolling restart mid-traffic — every request reaches
+    a terminal state, every success is oracle-identical, the invariant
+    holds."""
+    prompts, want = oracle
+    plan = FaultPlan()
+    for k in (2, 3, 11, 12, 25):
+        plan.at(replica_site(0, "engine"), k)
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=True,
+                **kw(retry_budget=4)) as router:
+        reqs = [Request(list(prompts[i % 6]), N_NEW) for i in range(36)]
+        for i, r in enumerate(reqs):
+            router.submit(r)
+            if i == 18:
+                router.rolling_restart(timeout_s=30)
+        assert router.wait(reqs, timeout_s=120)
+        s = router.summary()
+    n_ok = 0
+    for i, r in enumerate(reqs):
+        assert r.done
+        if r.error is None:
+            assert r.tokens == want[i % 6]
+            n_ok += 1
+    assert n_ok == len(reqs)          # retry budget 4 absorbs them all
+    assert s["fleet_accounting_ok"]
+    assert s["fleet_requests_finished"] == len(reqs)
+    assert router.pump_error is None
