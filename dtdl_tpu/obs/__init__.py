@@ -6,13 +6,19 @@ accounting, tail latencies), built to the PR-1 rule: nothing in here may
 add a host↔device sync to a hot loop.  See each module's docstring:
 
 trace      span("data"/"dispatch"/"drain") → Chrome trace JSON (Perfetto),
-           window-settled device track, jax.profiler annotations
+           window-settled device track, jax.profiler annotations,
+           request-correlated flow events + request_timeline(rid), the
+           audited span/event catalogs
 recompile  jit-cache sentinel: unexpected retraces are named, with the
            differing abstract args (warn / raise / silent)
 goodput    analytic model FLOPs (LM from config, CNNs from netspec),
            chip peaks, per-window MFU / tokens-per-sec / vs-roofline
 hist       streaming log-bucketed histogram: p50/p95/p99 in fixed memory
 observer   the Observer facade every loop takes (~3 lines per call site)
+export     boundary-sampled continuous metrics: JSONL series, Prometheus
+           text + opt-in http scrape endpoint, window-delta sources
+slo        declarative SLO targets over the exported series: error
+           budgets, burn-rate alerts, crossings as trace events
 
 Quick start::
 
@@ -26,6 +32,9 @@ Quick start::
     obs.close()                       # writes the Perfetto-loadable trace
 """
 
+from dtdl_tpu.obs.export import (  # noqa: F401
+    JsonlSeriesSink, MetricsExporter, PrometheusSink, prometheus_text,
+)
 from dtdl_tpu.obs.goodput import (  # noqa: F401
     GoodputMeter, lm_decode_flops, lm_forward_flops, lm_prefill_flops,
     lm_train_flops, lm_verify_flops, netspec_flops, peak_flops_per_chip,
@@ -35,6 +44,8 @@ from dtdl_tpu.obs.observer import NULL_OBSERVER, Observer  # noqa: F401
 from dtdl_tpu.obs.recompile import (  # noqa: F401
     RecompileError, RecompileEvent, RecompileSentinel,
 )
+from dtdl_tpu.obs.slo import SLO, SLOEvaluator  # noqa: F401
 from dtdl_tpu.obs.trace import (  # noqa: F401
-    NULL_TRACER, Tracer, aggregate, xla_events,
+    EVENT_CATALOG, NULL_TRACER, SPAN_CATALOG, Tracer, aggregate,
+    xla_events,
 )

@@ -75,6 +75,19 @@ class Observer:
         containment events).  Host-side only, like every verb here."""
         self.tracer.instant(name, **args)
 
+    def flow(self, name: str, fid: int, phase: str = "step",
+             **args) -> None:
+        """Chrome-trace flow event (start/step/end) joining spans across
+        threads under one correlation id — the serve layers call this
+        with the USER request rid so a hedged, failed-over request reads
+        as one arrow chain in Perfetto.  No-op when tracing is off."""
+        self.tracer.flow(name, fid, phase, **args)
+
+    def request_timeline(self, rid: int) -> list:
+        """All recorded events correlated with user request ``rid``,
+        ordered (see :meth:`Tracer.request_timeline`)."""
+        return self.tracer.request_timeline(rid)
+
     def watch(self, fn: Callable, name: str | None = None,
               expected: int = 1) -> Callable:
         """Recompile-sentinel wrap (identity for non-jit callables)."""

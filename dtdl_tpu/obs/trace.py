@@ -38,6 +38,27 @@ discipline":
 When a ``jax.profiler`` capture is active, each span also opens a
 ``TraceAnnotation`` (via :mod:`dtdl_tpu._compat` — never a hard dep) so
 host phases line up with XLA ops inside one Perfetto view.
+
+**Request correlation (round 16).**  Fleet-era serving spreads one user
+request over many threads — router intake, a pump dispatch, one worker
+per attempt (retries and hedges are *sibling* attempts) — and anonymous
+spans cannot be joined back into the request's story.  Every
+request-scoped event therefore carries correlation args: ``rid`` (the
+USER request id, stable across attempts), ``arid`` (the replica-local
+attempt id), and on dispatch a ``lineage`` field (``primary`` /
+``retry:N`` after N burned retries / ``requeue`` for a free
+backpressure re-dispatch / ``hedge``).  :meth:`Tracer.flow` adds Chrome-trace flow
+events (``ph`` s/t/f sharing ``id=rid``) so Perfetto draws the arrows
+from submit through every attempt to the winning completion, and
+:meth:`Tracer.request_timeline` reconstructs the same story
+programmatically — the ordered list of every recorded event correlated
+with one rid, whichever thread emitted it.
+
+The span/event catalogs below (:data:`SPAN_CATALOG` /
+:data:`EVENT_CATALOG`) are the single source of truth for names emitted
+anywhere in dtdl_tpu; tests/test_obs_export.py audits the source tree
+against them, so the catalog can no longer silently lag a new emitter
+(it did twice between PR 5 and PR 9).
 """
 
 from __future__ import annotations
@@ -54,6 +75,46 @@ from dtdl_tpu import _compat
 # synthetic track ids inside the exported trace: host spans carry the
 # real thread id; settled device windows live on their own track
 DEVICE_TID = 1
+
+# ---------------------------------------------------------------------------
+# the span/event catalog — every name emitted through Observer.span /
+# Observer.event / Tracer.instant anywhere in dtdl_tpu/.  Audited against
+# the source tree by tests/test_obs_export.py: add the name HERE when you
+# add an emitter, or the audit fails by name.
+# ---------------------------------------------------------------------------
+
+SPAN_CATALOG = frozenset({
+    # training loops (PR 3)
+    "data", "dispatch", "drain",
+    # serve scheduler (PR 2/4): admission, drafting, the k-wide verify
+    # dispatch, the lag harvest, and the per-admission prefill call
+    "admit", "draft", "verify", "harvest", "prefill",
+    # fleet router (PR 9)
+    "route",
+})
+
+EVENT_CATALOG = frozenset({
+    # resil (PR 5); trainer_rollback was emitted since PR 5 but missing
+    # from the documented catalog until the round-16 audit pinned it —
+    # exactly the drift the audit test exists to stop
+    "guard_bad_step", "guard_rollback", "trainer_preempted",
+    "trainer_rollback",
+    # serve scheduler containment + lifecycle (PR 5/6)
+    "request_expired", "request_cancelled", "engine_failure",
+    "scheduler_shutdown", "page_pool_shed",
+    # fleet health/lifecycle edges (PR 9); replica_* names are emitted as
+    # f"replica_{state}" over the health-machine states
+    "replica_suspect", "replica_evicted", "replica_draining",
+    "replica_healthy", "replica_restarted", "replica_drain_timeout",
+    "request_retry", "request_hedged", "hedge_won", "router_shutdown",
+    "router_drain_timeout", "router_pump_error",
+    # request-correlated lifecycle (round 16): intake → dispatch →
+    # admit → first token → terminal, every one carrying rid/arid
+    "request_submitted", "request_dispatched", "request_admitted",
+    "request_first_token", "request_finished", "request_done",
+    # SLO layer (round 16)
+    "slo_breach", "slo_recovered", "slo_burn_rate",
+})
 
 
 class _Span:
@@ -130,6 +191,52 @@ class Tracer:
                 "ts": (time.perf_counter() - self._t0) * 1e6,
                 "pid": self._meta["pid"], "tid": 0,
                 "args": {"value": value}})
+
+    _FLOW_PH = {"start": "s", "step": "t", "end": "f"}
+
+    def flow(self, name: str, fid: int, phase: str = "step",
+             **args) -> None:
+        """A Chrome-trace flow event: ``phase`` is ``start`` / ``step``
+        / ``end`` and every event sharing (``name``, ``fid``) is joined
+        into one arrow chain across threads — the Perfetto rendering of
+        a request's path through router intake, dispatch, and each
+        attempt's replica thread.  ``fid`` is the correlation id (the
+        fleet uses the USER request rid)."""
+        ph = self._FLOW_PH.get(phase)
+        if ph is None:
+            raise ValueError(f"flow phase must be one of "
+                             f"{sorted(self._FLOW_PH)}, got {phase!r}")
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            ev = {"name": name, "cat": "request", "ph": ph, "id": fid,
+                  "ts": (time.perf_counter() - self._t0) * 1e6,
+                  "pid": self._meta["pid"],
+                  "tid": threading.get_ident()}
+            if ph == "f":
+                ev["bp"] = "e"     # bind the arrowhead to the enclosing
+            if args:               # slice's end, the Perfetto convention
+                ev["args"] = args
+            self._events.append(ev)
+
+    def request_timeline(self, rid: int) -> list[dict]:
+        """Every recorded event correlated with USER request ``rid``,
+        ordered by timestamp — the programmatic reconstruction of one
+        request's story across threads, attempts, and failovers.
+
+        An event correlates when its args carry ``rid == rid`` (the
+        emitters thread the user rid through attempt clones, so a
+        retried/hedged request's sibling attempts all land here, each
+        distinguished by its ``arid``/``lineage`` args) or when it is a
+        flow event with ``id == rid``."""
+        with self._lock:
+            events = list(self._events)
+        out = [e for e in events
+               if e.get("args", {}).get("rid") == rid
+               or (e.get("cat") == "request" and e.get("id") == rid)]
+        out.sort(key=lambda e: e["ts"])
+        return out
 
     def device_window(self, name: str, seconds: float, steps: int = 1,
                       **args) -> None:
@@ -254,6 +361,13 @@ class NullTracer:
 
     def counter(self, name: str, value: float) -> None:
         pass
+
+    def flow(self, name: str, fid: int, phase: str = "step",
+             **args) -> None:
+        pass
+
+    def request_timeline(self, rid: int) -> list:
+        return []
 
     def device_window(self, name: str, seconds: float, steps: int = 1,
                       **args) -> None:

@@ -50,12 +50,14 @@ from dtdl_tpu.serve.engine import (  # noqa: F401
     InferenceEngine, PromptTooLongError, default_buckets,
 )
 from dtdl_tpu.serve.fleet import (  # noqa: F401
-    FleetMetrics, Replica, Router,
+    FleetMetrics, Replica, Router, default_fleet_slos,
 )
 from dtdl_tpu.serve.health import (  # noqa: F401
     DRAINING, EVICTED, HEALTHY, SUSPECT, ReplicaHealth,
 )
-from dtdl_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from dtdl_tpu.serve.metrics import (  # noqa: F401
+    ERROR_KINDS, UNAVAILABLE_KINDS, ServeMetrics, error_kind,
+)
 from dtdl_tpu.serve.paged import (  # noqa: F401
     GARBAGE_PAGE, PageAllocator, PagePoolExhaustedError,
 )

@@ -69,10 +69,12 @@ from typing import Optional, Sequence
 
 from dtdl_tpu.obs.hist import LogHistogram
 from dtdl_tpu.obs.observer import NULL_OBSERVER
+from dtdl_tpu.obs.slo import SLO, SLOEvaluator
 from dtdl_tpu.resil.faults import FaultPlan, InjectedFault, replica_site
 from dtdl_tpu.serve.health import (DRAINING, EVICTED, HEALTHY, SUSPECT,
                                    ReplicaHealth)
-from dtdl_tpu.serve.metrics import ServeMetrics
+from dtdl_tpu.serve.metrics import (UNAVAILABLE_KINDS, ServeMetrics,
+                                    _window_delta, error_kind)
 from dtdl_tpu.serve.scheduler import Request, Scheduler
 
 
@@ -160,8 +162,14 @@ class Replica:
         if self.plan is not None:
             engine = _FaultableEngine(
                 engine, self.plan, replica_site(self.idx, "engine"))
-        sched = Scheduler(engine, metrics=self.metrics,
-                          **self._sched_kwargs)
+        kw = dict(self._sched_kwargs)
+        if "observer" not in kw and self.observer is not NULL_OBSERVER:
+            # the Router's observer reaches into every replica, so the
+            # per-attempt spans/events of all workers land on ONE
+            # thread-safe tracer and request_timeline(rid) can join a
+            # request's attempts across replica threads
+            kw["observer"] = self.observer
+        sched = Scheduler(engine, metrics=self.metrics, **kw)
         sched._fleet_published = 0   # per-generation completion cursor
         return sched
 
@@ -351,6 +359,7 @@ class FleetMetrics:
         self.tok_latency_hist = LogHistogram()
         self._t_start: Optional[float] = None
         self._t_last: Optional[float] = None
+        self._win_prev: dict = {}      # window() delta baseline
 
     # ---- router hooks -------------------------------------------------
 
@@ -450,6 +459,63 @@ class FleetMetrics:
             "replicas": replicas,
         }
 
+    # monotonic fleet ledgers window() diffs (tails/rates pass through)
+    _WINDOW_COUNTERS = frozenset({
+        "fleet_requests_submitted", "fleet_requests_finished",
+        "fleet_requests_rejected", "fleet_requests_expired",
+        "fleet_requests_failed", "fleet_requests_aborted",
+        "fleet_retries", "fleet_hedges", "fleet_hedges_won",
+        "fleet_evictions", "fleet_failovers", "fleet_restarts",
+        "fleet_decode_tokens",
+    })
+
+    def window(self, replicas: Sequence[dict] = (),
+               health: Sequence[str] = ()) -> dict:
+        """Counter increments since the last :meth:`window` call plus
+        the current gauges/tails — the fleet-level exporter feed, same
+        contract as :meth:`ServeMetrics.window` (the cumulative
+        :meth:`summary` is untouched; nested replica summaries are
+        dropped — a series point is flat)."""
+        return _window_delta(self.summary(replicas, health),
+                             self._WINDOW_COUNTERS, self._win_prev)
+
+
+def default_fleet_slos(ttft_p99_s: Optional[float] = None,
+                       availability: Optional[float] = None,
+                       acceptance_rate: Optional[float] = None,
+                       window_s: float = 10.0) -> list:
+    """The standard serving objectives as :class:`~dtdl_tpu.obs.slo.
+    SLO` declarations over the Router's exported fields (pass the
+    result as ``Router(slos=...)``):
+
+    * ``ttft_p99_s`` — router-clock TTFT p99 ≤ the target, judged on
+      the fixed-memory histogram tail (``fleet_ttft_s_p99``);
+    * ``availability`` — finished / (finished + failed + expired) over
+      a rolling ``window_s``, the :data:`~dtdl_tpu.serve.metrics.
+      UNAVAILABLE_KINDS` classification: shed/rejected load management
+      and deliberate aborts never burn the budget;
+    * ``acceptance_rate`` — speculative-decode acceptance floor; this
+      field is per-scheduler (``spec_acceptance_rate``), so it needs a
+      ServeMetrics source on the same exporter.
+    """
+    slos = []
+    if ttft_p99_s is not None:
+        slos.append(SLO("ttft_p99", metric="fleet_ttft_s_p99",
+                        op="<=", target=ttft_p99_s))
+    if availability is not None:
+        slos.append(SLO(
+            "availability", good="fleet_requests_finished",
+            bad=tuple(f"fleet_requests_{k}" for k in UNAVAILABLE_KINDS),
+            target=availability, window_s=window_s))
+    if acceptance_rate is not None:
+        # gated on drafted tokens: the rate field exports 0.0 even in
+        # windows with speculation off — judging those would breach the
+        # floor on every idle window
+        slos.append(SLO("acceptance", metric="spec_acceptance_rate",
+                        op=">=", target=acceptance_rate,
+                        gate="spec_drafted_tokens"))
+    return slos
+
 
 @dataclasses.dataclass
 class _Flight:
@@ -492,7 +558,8 @@ class Router:
                  evict_after: int = 2, recover_after: int = 2,
                  auto_restart: bool = True, metrics: FleetMetrics = None,
                  observer=None, plan: Optional[FaultPlan] = None,
-                 poll_s: float = 0.002, warmup: bool = True):
+                 poll_s: float = 0.002, warmup: bool = True,
+                 exporter=None, slos=None):
         if isinstance(engines, (list, tuple)):
             engines = list(engines)
             if n_replicas is not None and n_replicas != len(engines):
@@ -562,6 +629,28 @@ class Router:
         self._closed = False
         self._stop = False
         self.pump_error: Optional[str] = None
+        # continuous export + SLO judging (round 16): the pump samples
+        # the exporter once per tick (self-throttled), feeding the
+        # fleet-level window deltas; an attached SLOEvaluator judges
+        # every sampled point and its crossings land on this router's
+        # trace.  `slos` may be a list of SLO objects or a ready
+        # SLOEvaluator; passing slos without an exporter builds a
+        # sink-less one (the evaluator still judges, summary() still
+        # rolls up — add sinks/serve_http for the series artifacts).
+        self._own_exporter = False
+        if slos is not None and exporter is None:
+            from dtdl_tpu.obs.export import MetricsExporter
+            exporter = MetricsExporter()
+            self._own_exporter = True
+        self.exporter = exporter
+        if exporter is not None:
+            exporter.add_source("", self._export_window)
+            if slos is not None:
+                if not isinstance(slos, SLOEvaluator):
+                    slos = SLOEvaluator(slos)
+                if slos.observer is None:
+                    slos.observer = self.observer
+                exporter.attach_slo(slos)
         for rep in self.replicas:
             rep._on_complete = self._wake
         self._pump = threading.Thread(target=self._pump_loop,
@@ -569,6 +658,20 @@ class Router:
         self._pump.start()
 
     # ---- intake -------------------------------------------------------
+
+    @property
+    def slo(self):
+        """The live SLO evaluator (read through the exporter, so one
+        attached after construction via ``exporter.attach_slo`` still
+        shows up in :meth:`summary`)."""
+        return self.exporter.slo if self.exporter is not None else None
+
+    def _export_window(self) -> dict:
+        """The fleet-level exporter feed: FleetMetrics window deltas
+        plus current replica-health gauges (host state only)."""
+        return self.metrics.window(
+            [rep.metrics.summary() for rep in self.replicas],
+            health=[h.state for h in self.health])
 
     def _wake(self) -> None:
         with self._cv:
@@ -597,6 +700,17 @@ class Router:
             self.metrics.on_submit()
             fl = _Flight(req, now)
             self._flights[req.rid] = fl
+            # the correlated intake marker + the flow chain's anchor:
+            # every later attempt/SLO/health event for this request
+            # joins this id.  Emitted UNDER the lock, before the pump
+            # can pop the flight — dispatch needs this lock, so the
+            # submit event's timestamp always precedes the dispatch
+            # event's and the timeline/flow chain reads in causal order
+            # (the tracer lock is a leaf; no ordering cycle).
+            self.observer.event("request_submitted", rid=req.rid,
+                                prompt_len=len(req.prompt),
+                                max_new_tokens=req.max_new_tokens)
+            self.observer.flow("req", req.rid, "start")
             self.queue.append(fl)
             self._cv.notify_all()
         return req
@@ -609,6 +723,11 @@ class Router:
         req.t_done = time.perf_counter()
         hook()
         self.finished.append(req)
+        # intake-time rejection: the request never started a flow chain
+        # (request_submitted/flow-start are for ACCEPTED requests), so
+        # only the terminal marker is emitted — no dangling flow end
+        self.observer.event("request_done", rid=req.rid,
+                            kind=error_kind(error), attempts=0)
         self._cv.notify_all()
         return req
 
@@ -646,6 +765,16 @@ class Router:
                 self._by_attempt.pop(rid, None)
             self.finished.append(user)
             self._cv.notify_all()
+        # the terminal correlation marker: which attempt won (arid), how
+        # many were ever dispatched, and the outcome kind — the last
+        # entry of request_timeline(rid), closing the flow chain
+        self.observer.event(
+            "request_done", rid=user.rid,
+            kind=error_kind(user.error) if user.error else "finished",
+            attempts=len(fl.attempts), retries=fl.retries,
+            hedged=int(fl.hedged),
+            **({"arid": attempt.rid} if attempt is not None else {}))
+        self.observer.flow("req", user.rid, "end")
         for rid, j in losers:
             # best-effort: a loser past cancellation finishes on its
             # replica and is dropped at collection (user already done)
@@ -683,6 +812,12 @@ class Router:
         self._expire_queued()
         self._dispatch()
         self._hedge()
+        if self.exporter is not None:
+            # the pump tick is the router's drain boundary: completions
+            # above are collected and settled, so the sampled counters
+            # are consistent.  The exporter throttles itself — a tick
+            # that lands inside interval_s costs one clock read.
+            self.exporter.sample()
 
     # ---- completions --------------------------------------------------
 
@@ -703,10 +838,11 @@ class Router:
             self.health[i].on_success()
             if fl.hedged and att.rid == fl.hedge_rid and not user.done:
                 self.metrics.on_hedge_won()
-                self.observer.event("hedge_won", rid=user.rid, replica=i)
+                self.observer.event("hedge_won", rid=user.rid,
+                                    arid=att.rid, replica=i)
             self._finish_user(fl, None, None, attempt=att)
             return
-        kind = att.error.split(":", 1)[0]
+        kind = error_kind(att.error)
         if user.done:
             return                     # a raced loser; already delivered
         if kind == "expired":
@@ -967,7 +1103,16 @@ class Router:
                         fl = self.queue.popleft()
                         if fl.req.done:
                             continue
-                        att = self._clone(fl.req)
+                        # lineage: the first dispatch is the primary;
+                        # later dispatches are labeled by how many
+                        # retries the flight has BURNED (hedges and
+                        # free backpressure requeues never advance the
+                        # index — a requeue before any burn is its own
+                        # flavor)
+                        lineage = ("primary" if not fl.attempts
+                                   else f"retry:{fl.retries}"
+                                   if fl.retries else "requeue")
+                        att = self._clone(fl.req, lineage)
                         now = time.perf_counter()
                         fl.live[att.rid] = target
                         fl.attempts.append((att.rid, target, now))
@@ -981,17 +1126,26 @@ class Router:
                                 "replica evicted)",
                             self.metrics.on_failed)
                     return
+                self.observer.event("request_dispatched",
+                                    rid=fl.req.rid, arid=att.rid,
+                                    replica=target, lineage=att.lineage,
+                                    retries=fl.retries)
+                self.observer.flow("req", fl.req.rid, "step")
                 self.replicas[target].submit(att)
 
-    def _clone(self, user: Request) -> Request:
+    def _clone(self, user: Request, lineage: str = "primary") -> Request:
         """A fresh replica-local attempt for a user request: same
-        generation parameters, its own rid/lifecycle, and the USER's
+        generation parameters, its own rid/lifecycle, the USER's
         absolute deadline — router queue time and earlier failed
-        attempts all count against the one budget."""
+        attempts all count against the one budget — and the
+        trace-correlation stamp (``origin_rid`` = the user rid,
+        ``lineage`` = primary / retry:N / requeue / hedge) that lets
+        ``request_timeline(rid)`` join sibling attempts."""
         return Request(list(user.prompt), user.max_new_tokens,
                        sampling=user.sampling, eos_id=user.eos_id,
                        speculate=user.speculate,
-                       deadline_at=user.deadline_at)
+                       deadline_at=user.deadline_at,
+                       origin_rid=user.rid, lineage=lineage)
 
     def _hedge(self) -> None:
         if self.hedge_after_s is None:
@@ -1009,7 +1163,7 @@ class Router:
                 j = self._pick(exclude=first_rep)
                 if j is None:
                     continue
-                att = self._clone(fl.req)
+                att = self._clone(fl.req, "hedge")
                 fl.hedged = True
                 fl.hedge_rid = att.rid
                 fl.live[att.rid] = j
@@ -1019,7 +1173,12 @@ class Router:
                 self.metrics.on_hedge()
                 todo.append((j, att, fl.req.rid))
         for j, att, rid in todo:
-            self.observer.event("request_hedged", rid=rid, replica=j)
+            # the hedge IS this flight's second dispatch: one event with
+            # the sibling-attempt correlation (rid joins it to the
+            # primary, arid/lineage tell the attempts apart)
+            self.observer.event("request_hedged", rid=rid, arid=att.rid,
+                                replica=j, lineage="hedge")
+            self.observer.flow("req", rid, "step")
             self.replicas[j].submit(att)
 
     # ---- lifecycle ----------------------------------------------------
@@ -1137,6 +1296,13 @@ class Router:
         for rep in self.replicas:
             rep.stop(drain=drain)
         self._collect()    # pump is gone: settle the last completions
+        if self.exporter is not None:
+            # the final point carries the settled books, so the series
+            # telescopes to the end-of-run summary (the invariant test
+            # sums the window deltas and must land exactly there)
+            self.exporter.sample(force=True)
+            if self._own_exporter:
+                self.exporter.close()
 
     def __enter__(self) -> "Router":
         return self
@@ -1150,7 +1316,14 @@ class Router:
     def summary(self) -> dict:
         """Fleet-level metrics with per-replica summaries nested under
         ``replicas`` (call after :meth:`wait` / :meth:`shutdown` so the
-        harvest-side numbers are settled)."""
-        return self.metrics.summary(
+        harvest-side numbers are settled); when an exporter/SLO layer
+        is attached, the export volume and per-SLO verdict rollup ride
+        along."""
+        out = self.metrics.summary(
             [rep.metrics.summary() for rep in self.replicas],
             health=[h.state for h in self.health])
+        if self.exporter is not None:
+            out["export_snapshots"] = self.exporter.n_snapshots
+        if self.slo is not None:
+            out.update(self.slo.summary())
+        return out

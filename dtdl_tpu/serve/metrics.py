@@ -37,6 +37,49 @@ from dtdl_tpu.obs.hist import LogHistogram
 # the fixed-memory histograms (which see EVERY sample) keep growing stats
 _MAX_SAMPLES = 65536
 
+# ---------------------------------------------------------------------------
+# terminal error kinds — the one place that knows the ``req.error``
+# prefix grammar.  Every terminal error is "<kind>: <reason>" (PR 9);
+# callers branch through error_kind() instead of scattering
+# string-splitting (the fleet Router, the exporter/SLO availability
+# accounting, and Scheduler._finish_error all share this list).
+# ---------------------------------------------------------------------------
+
+ERROR_KINDS = ("rejected", "expired", "failed", "aborted", "shed")
+
+# which kinds count AGAINST availability in the SLO layer: failed
+# (engine/replica health) and expired (the service blew the deadline)
+# are service faults; rejected/shed are deliberate load management and
+# aborted is a caller/shutdown decision — charging those to
+# availability would make every graceful drain an outage
+UNAVAILABLE_KINDS = ("failed", "expired")
+
+
+def error_kind(error) -> str | None:
+    """The machine-checkable kind prefix of a terminal ``req.error``
+    (one of :data:`ERROR_KINDS`), or None for no error / an unprefixed
+    string.  The single string-parsing point for the kind grammar."""
+    if not error:
+        return None
+    kind = error.split(":", 1)[0]
+    return kind if kind in ERROR_KINDS else None
+
+
+def _window_delta(summary: dict, counters, prev: dict) -> dict:
+    """Flatten ``summary`` to numeric scalars, replacing each field in
+    ``counters`` with its increment since the last call (state in
+    ``prev``, updated in place).  Gauges/tails pass through at their
+    current value; bools become 0/1 ints; nested dicts/lists are
+    dropped (a time series point is flat by contract)."""
+    out = {}
+    for k, v in summary.items():
+        if isinstance(v, bool):
+            out[k] = int(v)
+        elif isinstance(v, (int, float)):
+            out[k] = v - prev.get(k, 0) if k in counters else v
+    prev.update({k: summary[k] for k in counters if k in summary})
+    return out
+
 
 class ServeMetrics:
     """Scheduler-driven serving telemetry (see module docstring)."""
@@ -86,6 +129,7 @@ class ServeMetrics:
         self._t_start = None
         self._t_last_harvest = None
         self._occupancy: list[dict] = []
+        self._win_prev: dict = {}      # window() delta baseline
 
     # ---- scheduler hooks ---------------------------------------------
 
@@ -264,3 +308,25 @@ class ServeMetrics:
             **self.ttft_hist.summary("ttft_s_"),
             **self.tok_latency_hist.summary("tok_latency_s_"),
         }
+
+    # the monotonically-increasing summary fields window() diffs; rates,
+    # occupancy, tails, and page gauges pass through at current value
+    _WINDOW_COUNTERS = frozenset({
+        "requests_submitted", "requests_rejected", "requests_expired",
+        "requests_failed", "requests_aborted", "requests_finished",
+        "requests_shed", "prefill_tokens", "decode_steps",
+        "decode_tokens", "prefill_tokens_saved", "spec_steps",
+        "spec_drafted_tokens", "spec_accepted_tokens", "draft_s",
+    })
+
+    def window(self) -> dict:
+        """Counters since the last :meth:`window` call — the delta feed
+        a continuous exporter samples at drain/harvest boundaries, so it
+        never re-implements diffing.  Counter fields (see
+        ``_WINDOW_COUNTERS``) come back as increments; everything else
+        numeric (rates, tails, occupancy, page gauges) rides along at
+        its current value, and non-scalar fields are dropped.  The
+        cumulative :meth:`summary` contract is untouched — both read the
+        same books; only this method keeps a baseline."""
+        return _window_delta(self.summary(), self._WINDOW_COUNTERS,
+                             self._win_prev)

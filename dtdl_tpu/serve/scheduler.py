@@ -88,7 +88,7 @@ import numpy as np
 from dtdl_tpu.obs.observer import NULL_OBSERVER
 from dtdl_tpu.serve.draft import DraftSource, NGramDraft
 from dtdl_tpu.serve.engine import InferenceEngine, PromptTooLongError
-from dtdl_tpu.serve.metrics import ServeMetrics
+from dtdl_tpu.serve.metrics import ERROR_KINDS, ServeMetrics
 from dtdl_tpu.serve.paged import (GARBAGE_PAGE, PageAllocator,
                                   PagePoolExhaustedError)
 from dtdl_tpu.serve.sampling import GREEDY, SampleParams
@@ -122,6 +122,17 @@ class Request:
     allowance in a router queue and still get a fresh one at the
     engine.  When only ``deadline_s`` is given, ``submit`` derives
     ``deadline_at = t_submit + deadline_s``.
+
+    ``origin_rid``/``lineage`` are the trace-correlation fields (round
+    16): a fleet Router stamps each replica-local attempt clone with
+    the USER request's rid and how the attempt came to be (``primary``
+    / ``retry:N`` after N burned retries / ``requeue`` for a free
+    backpressure re-dispatch / ``hedge``), so every request-scoped
+    trace event the
+    scheduler emits carries the user rid and
+    ``Tracer.request_timeline(rid)`` can reassemble a hedged,
+    failed-over request across threads.  Standalone requests leave them
+    at the defaults (their own rid is the correlation id).
     """
     prompt: Sequence[int]
     max_new_tokens: int
@@ -130,6 +141,8 @@ class Request:
     speculate: int = 0
     deadline_s: Optional[float] = None
     deadline_at: Optional[float] = None
+    origin_rid: Optional[int] = None
+    lineage: str = "primary"
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
@@ -246,7 +259,7 @@ class Scheduler:
                  harvest_lag: int = 4, metrics: ServeMetrics = None,
                  observer=None, draft: Optional[DraftSource] = None,
                  max_queue: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, exporter=None):
         if harvest_lag < 0:
             raise ValueError(f"harvest_lag must be >= 0, got "
                              f"{harvest_lag}")
@@ -257,6 +270,10 @@ class Scheduler:
         self.observer = observer or NULL_OBSERVER
         if observer is not None and engine.observer is None:
             engine.observer = observer   # sentinel on the engine's jits
+        # continuous metrics export (dtdl_tpu/obs/export.py): sampled at
+        # the boundaries this loop already settles at — step's harvest
+        # and drain() — never per token; the exporter throttles itself
+        self.exporter = exporter
         self.engine = engine
         self.draft = draft if draft is not None else NGramDraft()
         draft_model = getattr(self.draft, "model", None)
@@ -271,6 +288,11 @@ class Scheduler:
         self.slots: list[Optional[Request]] = [None] * engine.n_slots
         self.harvest_lag = harvest_lag
         self.metrics = metrics or ServeMetrics(n_slots=engine.n_slots)
+        if exporter is not None:
+            # this scheduler's window-delta feed; callers stack further
+            # sources (goodput totals, guard counters) on the same
+            # exporter before or after construction
+            exporter.add_source("", self.metrics.window)
         self.finished: list[Request] = []
         self._reqs: dict[int, Request] = {}
         self._active = np.zeros(engine.n_slots, bool)
@@ -311,7 +333,18 @@ class Scheduler:
 
     # ---- intake -------------------------------------------------------
 
-    _ERROR_KINDS = ("rejected", "expired", "failed", "aborted", "shed")
+    _ERROR_KINDS = ERROR_KINDS
+
+    def _corr(self, req: Request) -> dict:
+        """Trace-correlation args for request-scoped events: ``rid`` is
+        the USER request id (the fleet Router stamps ``origin_rid`` on
+        attempt clones; standalone requests are their own origin),
+        ``arid`` the local attempt id — so
+        ``Tracer.request_timeline(rid)`` collects every attempt's
+        events under the one user rid while ``arid`` tells the sibling
+        attempts apart."""
+        rid = req.origin_rid if req.origin_rid is not None else req.rid
+        return {"rid": rid, "arid": req.rid}
 
     def _finish_error(self, req: Request, reason: str,
                       metric_hook, kind: str) -> Request:
@@ -328,6 +361,13 @@ class Scheduler:
         req.t_done = time.perf_counter()
         self.finished.append(req)
         metric_hook(req)
+        if req.origin_rid is None and req.admit_step >= 0:
+            # a STANDALONE request that was admitted started a flow
+            # chain at admission — every terminal funnels through here,
+            # so close it (never-admitted requests started none, and
+            # fleet attempts' chains are closed by the Router's
+            # request_done, which owns the user-level outcome)
+            self.observer.flow("req", req.rid, "end")
         return req
 
     def _reject(self, req: Request, reason: str) -> Request:
@@ -448,7 +488,8 @@ class Scheduler:
             self._finish_error(
                 req, f"deadline {budget(req)} exceeded before "
                      f"admission", self.metrics.on_expire, "expired")
-            self.observer.event("request_expired", rid=req.rid, queued=1)
+            self.observer.event("request_expired", queued=1,
+                                **self._corr(req))
         for slot, req in enumerate(self.slots):
             if req is None or not self._active[slot] or not expired(req):
                 continue
@@ -456,7 +497,8 @@ class Scheduler:
                 req, f"deadline {budget(req)} exceeded after "
                      f"{len(req.tokens)} tokens", self.metrics.on_expire,
                 "expired")
-            self.observer.event("request_expired", rid=req.rid, slot=slot)
+            self.observer.event("request_expired", slot=slot,
+                                **self._corr(req))
             self._retire(slot)
 
     # ---- router-facing hooks (dtdl_tpu/serve/fleet.py) ----------------
@@ -498,15 +540,16 @@ class Scheduler:
             self._finish_error(
                 req, f"cancelled before admission: {reason}",
                 self.metrics.on_abort, "aborted")
-            self.observer.event("request_cancelled", rid=rid, queued=1)
+            self.observer.event("request_cancelled", queued=1,
+                                **self._corr(req))
             return True
         for slot, r in enumerate(self.slots):
             if r is req:
                 self._finish_error(
                     req, f"cancelled after {len(req.tokens)} tokens: "
                          f"{reason}", self.metrics.on_abort, "aborted")
-                self.observer.event("request_cancelled", rid=rid,
-                                    slot=slot)
+                self.observer.event("request_cancelled", slot=slot,
+                                    **self._corr(req))
                 self._retire(slot)
                 return True
         return False     # retired-awaiting-harvest: let it finish
@@ -615,10 +658,14 @@ class Scheduler:
                           if self.pages.prefix_cache else [])
             self.queue.popleft()
             sp = req.sampling
+            corr = self._corr(req)
             try:
-                self.arena, self.last_tokens, _ = self.engine.prefill(
-                    self.arena, self.last_tokens, slot, suffix, sp,
-                    self._next_key(), page_row=row, start=start)
+                with self.observer.span("prefill", slot=slot,
+                                        suffix_len=len(suffix),
+                                        cached=start, **corr):
+                    self.arena, self.last_tokens, _ = self.engine.prefill(
+                        self.arena, self.last_tokens, slot, suffix, sp,
+                        self._next_key(), page_row=row, start=start)
             except Exception as e:
                 # the arena was donated into the failing program: condemn
                 # the in-flight batch (and this request), keep the queue
@@ -646,6 +693,19 @@ class Scheduler:
             self._topp[slot] = sp.top_p
             req.t_admit = time.perf_counter()
             req.admit_step = self.step_count
+            # correlated admission marker on this worker's track: the
+            # queue-wait is readable as (this ts - the submit/dispatch
+            # event's), and the flow arrow joins the attempt to its
+            # user request's chain (standalone requests START the flow
+            # here; fleet attempts continue the router's)
+            self.observer.event("request_admitted", slot=slot,
+                                step=self.step_count,
+                                prompt_len=len(req.prompt),
+                                cached=start, lineage=req.lineage,
+                                **corr)
+            self.observer.flow(
+                "req", corr["rid"],
+                "step" if req.origin_rid is not None else "start")
             req._guaranteed = 1
             self._state[slot].dispatched(0)
             self._pending.append(
@@ -699,8 +759,8 @@ class Scheduler:
                 self._finish_error(
                     req, f"{e} (shed after {len(req.tokens)} harvested "
                          f"tokens)", self.metrics.on_shed, "shed")
-                self.observer.event("page_pool_shed", rid=req.rid,
-                                    slot=slot)
+                self.observer.event("page_pool_shed", slot=slot,
+                                    **self._corr(req))
                 self._retire(slot)
 
     # ---- drafting -----------------------------------------------------
@@ -802,6 +862,12 @@ class Scheduler:
             with self.observer.span("harvest"):
                 while len(self._pending) > self.harvest_lag:
                     self._harvest_one()
+        if self.exporter is not None:
+            # harvest boundary: the metrics this samples were already
+            # settled by the lag harvest above — host counters only,
+            # and the exporter's own interval throttle decides whether
+            # this boundary becomes a series point
+            self.exporter.sample()
         return n_active
 
     def _dispatch_round(self, n_active: int):
@@ -879,6 +945,8 @@ class Scheduler:
                 if len(req.tokens) == 1:
                     req.t_first = now
                     self.metrics.on_first_token(req)
+                    self.observer.event("request_first_token",
+                                        slot=slot, **self._corr(req))
                 hit_eos = (req.eos_id is not None
                            and req.tokens[-1] == req.eos_id)
                 if hit_eos or len(req.tokens) >= budget:
@@ -886,6 +954,13 @@ class Scheduler:
                     req.t_done = now
                     self.finished.append(req)
                     self.metrics.on_finish(req)
+                    corr = self._corr(req)
+                    self.observer.event("request_finished",
+                                        tokens=len(req.tokens),
+                                        eos=int(hit_eos), **corr)
+                    self.observer.flow(
+                        "req", corr["rid"],
+                        "step" if req.origin_rid is not None else "end")
                     break        # EOS mid-window trims exactly
             # decode-token accounting counts DELIVERED generated tokens
             # (the request's very first token is the prefill's)
@@ -899,6 +974,8 @@ class Scheduler:
         with self.observer.span("drain"):
             while self._pending:
                 self._harvest_one()
+        if self.exporter is not None:
+            self.exporter.sample()
 
     # ---- shutdown -----------------------------------------------------
 
@@ -933,6 +1010,8 @@ class Scheduler:
             while any(s is not None for s in self.slots):
                 self.step()
             self.drain()
+            if self.exporter is not None:
+                self.exporter.sample(force=True)   # the final point
             return
         self.drain()     # settle what the device already computed
         for slot, req in enumerate(self.slots):
@@ -943,6 +1022,8 @@ class Scheduler:
             self._finish_error(req, "scheduler shut down",
                                self.metrics.on_abort, "aborted")
             self._retire(slot)
+        if self.exporter is not None:
+            self.exporter.sample(force=True)
 
     def __enter__(self) -> "Scheduler":
         return self

@@ -15,6 +15,12 @@ everything the single-engine serve_lm.py demo cannot survive, it can.
     python examples/serve_fleet.py --rolling-restart
     # tail-latency hedging
     python examples/serve_fleet.py --hedge-after 0.05
+    # full observability pipeline: correlated tracing + continuous
+    # export + SLO judging — writes a Perfetto trace, a JSONL series,
+    # serves GET /metrics, and prints one request's correlated timeline
+    python examples/serve_fleet.py --trace /tmp/fleet.json \
+        --metrics-jsonl /tmp/fleet_series.jsonl --metrics-port 0 \
+        --slo-ttft-p99 0.5 --slo-availability 0.999
 """
 
 import time
@@ -52,6 +58,23 @@ def main():
     flag(parser, "--watchdog", type=float, default=0.25,
          help="seconds of stale worker heartbeat (with work "
               "outstanding) before the stall signal fires")
+    flag(parser, "--trace", default=None,
+         help="write a request-correlated Chrome trace here "
+              "(Perfetto-loadable; spans + per-request flow events)")
+    flag(parser, "--metrics-jsonl", default=None,
+         help="append continuous window-delta metric snapshots here "
+              "(one JSON object per sampled boundary)")
+    flag(parser, "--metrics-port", type=int, default=-1,
+         help="serve GET /metrics (Prometheus text) on this port "
+              "(0 = pick a free port; -1 = off)")
+    flag(parser, "--metrics-interval", type=float, default=0.25,
+         help="minimum seconds between exported snapshots")
+    flag(parser, "--slo-ttft-p99", type=float, default=0.0,
+         help="SLO: router-clock TTFT p99 target in seconds "
+              "(0 = off); crossings land in the trace AND the series")
+    flag(parser, "--slo-availability", type=float, default=0.0,
+         help="SLO: availability floor, e.g. 0.999 (0 = off); bad = "
+              "failed + expired over a rolling window")
     flag(parser, "--seed", type=int, default=0)
     args = parser.parse_args()
     bootstrap(args)
@@ -81,11 +104,31 @@ def main():
                     args.max_new_tokens)
             for _ in range(args.n_requests)]
 
+    # the round-16 observability pipeline (all opt-in): correlated
+    # tracing, continuous boundary-sampled export, SLO judging
+    from dtdl_tpu.obs import JsonlSeriesSink, MetricsExporter, Observer
+    from dtdl_tpu.serve import default_fleet_slos
+    observer = Observer(trace=bool(args.trace), trace_path=args.trace)
+    exporter = None
+    if (args.metrics_jsonl or args.metrics_port >= 0
+            or args.slo_ttft_p99 or args.slo_availability):
+        sinks = ([JsonlSeriesSink(args.metrics_jsonl)]
+                 if args.metrics_jsonl else [])
+        exporter = MetricsExporter(sinks=sinks,
+                                   interval_s=args.metrics_interval)
+        if args.metrics_port >= 0:
+            port = exporter.serve_http(port=args.metrics_port)
+            print(f"scraping: curl http://127.0.0.1:{port}/metrics")
+    slos = default_fleet_slos(
+        ttft_p99_s=args.slo_ttft_p99 or None,
+        availability=args.slo_availability or None) or None
+
     t0 = time.perf_counter()
     with Router(engine, n_replicas=args.n_replicas, plan=plan,
                 retry_budget=args.retry_budget,
                 hedge_after_s=args.hedge_after or None,
-                watchdog_s=args.watchdog,
+                watchdog_s=args.watchdog, observer=observer,
+                exporter=exporter, slos=slos,
                 sched_kwargs={"harvest_lag": 4}) as router:
         for r in reqs:
             router.submit(r)
@@ -97,8 +140,10 @@ def main():
             print("WARNING: fleet did not settle "
                   f"(pump_error={router.pump_error})")
         dt = time.perf_counter() - t0
-        s = router.summary()
         evicts = list(router.evict_log)
+    # summary AFTER shutdown: the books are settled and the exporter's
+    # final forced snapshot (and any SLO verdicts on it) are included
+    s = router.summary()
 
     n_ok = sum(1 for r in reqs if r.done and r.error is None)
     n_err = sum(1 for r in reqs if r.error is not None)
@@ -134,6 +179,28 @@ def main():
           f"[{'OK' if s['fleet_accounting_ok'] and acc else 'VIOLATED'}]"
           f"  requests lost: {s['fleet_requests_submitted'] - acc}")
     print(f"  replica health: {s['replica_health']}")
+    if exporter is not None:
+        slo_bits = {k: v for k, v in s.items() if k.startswith("slo_")}
+        print(f"  export: {s.get('export_snapshots', 0)} snapshots"
+              + (f" -> {args.metrics_jsonl}" if args.metrics_jsonl
+                 else "")
+              + (f"  SLO: {slo_bits}" if slo_bits else ""))
+        exporter.close()
+    if args.trace:
+        # one request's correlated story, reconstructed from the trace:
+        # intake -> dispatch (every attempt, with lineage) -> admit ->
+        # first token -> terminal — what Perfetto draws as flow arrows
+        probe = next((r for r in reqs if r.done), None)
+        if probe is not None:
+            tl = observer.request_timeline(probe.rid)
+            steps = [f"{e['ts'] / 1e6:+.3f}s {e['name']}"
+                     + (f"[{e['args']['lineage']}]"
+                        if e.get("args", {}).get("lineage") else "")
+                     for e in tl if e.get("ph") in ("i", "X")]
+            print(f"  timeline rid={probe.rid}: " + " -> ".join(steps))
+        observer.close()
+        print(f"  trace written to {args.trace} (load in Perfetto; "
+              f"flow arrows join each request's attempts)")
 
 
 if __name__ == "__main__":

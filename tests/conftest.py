@@ -54,3 +54,46 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+# ---------------------------------------------------------------------------
+# Budget discipline (round 16): tier-1 ran 768s of the 870s budget at
+# PR 9, so an unmarked compile-heavy test can push the whole suite past
+# timeout.  This check flags every test that ran slower than
+# DTDL_BUDGET_SLOW_S (default 10s) WITHOUT a `slow` mark, as a loud
+# terminal section — new observability/serve tests get slow-marked
+# instead of silently eating the remaining headroom.  Set
+# DTDL_BUDGET_STRICT=1 to turn the flag into a session failure.
+# ---------------------------------------------------------------------------
+
+_SLOW_MARKED: set = set()
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.get_closest_marker("slow") is not None:
+            _SLOW_MARKED.add(item.nodeid)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    threshold = float(os.environ.get("DTDL_BUDGET_SLOW_S", "10"))
+    offenders = []
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            if (getattr(rep, "when", None) == "call"
+                    and getattr(rep, "duration", 0.0) > threshold
+                    and rep.nodeid not in _SLOW_MARKED):
+                offenders.append((rep.duration, rep.nodeid))
+    if not offenders:
+        return
+    tr = terminalreporter
+    tr.section("budget discipline", sep="=")
+    tr.write_line(
+        f"{len(offenders)} unmarked test(s) slower than {threshold:.0f}s "
+        f"— mark them @pytest.mark.slow or make them cheaper "
+        f"(tier-1 runs under a hard 870s budget):")
+    for dur, nodeid in sorted(offenders, reverse=True):
+        tr.write_line(f"  {dur:7.1f}s  {nodeid}")
+    if os.environ.get("DTDL_BUDGET_STRICT"):
+        pytest.exit("budget discipline violated (DTDL_BUDGET_STRICT=1)",
+                    returncode=1)
