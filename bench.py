@@ -38,7 +38,9 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import tempfile
 import time
+from collections import Counter
 
 import jax
 import jax.numpy as jnp
@@ -888,6 +890,149 @@ def bench_fleet(n_requests: int = 24, new_tokens: int = 24) -> dict:
     return row
 
 
+def bench_elastic(n_workers: int = 4, steps: int = 12,
+                  overhead_steps: int = 24, reps: int = 3) -> dict:
+    """Elastic-training row (ISSUE 12): the kill-one-of-N drill's MTTR
+    decomposition plus the liveness-layer overhead receipt.
+
+    Drill: ``n_workers`` thread-hosted ElasticWorkers train a tiny MLP
+    through the host control-plane store; ``peer_site`` kills one
+    mid-run.  Receipts decompose MTTR exactly as SCALING.md's failure
+    model does: ``detect_s`` (victim death → first survivor's named
+    PeerLostError; bounded by watchdog_s + a poll slice), ``reform_s``
+    (abort → new-generation world formed), ``restore_s`` (world →
+    committed snapshot restored), ``first_step_s`` (restore → first
+    applied step of the shrunken world), and ``mttr_s`` = death → first
+    new step.  ``samples_lost``/``samples_double_counted`` audit the
+    effective timeline against the world-size-agnostic sampler and must
+    both be ZERO.
+
+    Overhead: the same 2-worker world with the heartbeat lease layer on
+    vs off (interleaved best-of-``reps``); the liveness layer is
+    host-threads-only — zero device syncs by construction — so
+    ``liveness_overhead_frac`` must sit inside the obs <2% contract.
+    """
+    from dtdl_tpu.data.sharding import GlobalBatchSampler
+    from dtdl_tpu.models import MLP
+    from dtdl_tpu.parallel.kvstore import HostKVStore, RetryingStore
+    from dtdl_tpu.resil import (ElasticConfig, ElasticWorker, FaultPlan,
+                                effective_sample_log, peer_site,
+                                run_workers)
+    from dtdl_tpu.train import init_state
+
+    n_ex, dim, gbatch = 96, 16, 12
+    rng = np.random.default_rng(0)
+    x_all = rng.normal(size=(n_ex, dim)).astype(np.float32)
+    y_all = rng.integers(0, 10, n_ex)
+    model = MLP(n_units=8)
+    state0 = init_state(model, jax.random.PRNGKey(0),
+                        jnp.zeros((1, dim)), optax.sgd(0.1))
+
+    def loss(p, b):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            model.apply({"params": p}, b["x"]), b["y"]).mean()
+
+    grad_jit = jax.jit(lambda p, b: jax.grad(loss)(p, b))
+    apply_jit = jax.jit(lambda s, g, n: s.apply_gradients(
+        grads=jax.tree.map(lambda v: v / n, g)))
+    grad_fn = lambda s, b: grad_jit(s.params, b)          # noqa: E731
+    apply_fn = lambda s, g, n: apply_jit(s, g, float(n))  # noqa: E731
+    batch_fn = lambda i: {"x": jnp.asarray(x_all[i]),     # noqa: E731
+                          "y": jnp.asarray(y_all[i])}
+    # warm the compiled step outside every timed region (a first-call
+    # compile inside a worker reads as a wedge to the step deadline)
+    apply_fn(state0, jax.device_get(grad_fn(state0,
+                                            batch_fn(np.arange(4)))), 2)
+
+    def mk_world(store, ranks, n_steps, cfg, ckpt_dir=None):
+        sampler = GlobalBatchSampler(n_ex, gbatch, seed=3)
+        return [ElasticWorker(
+            RetryingStore(store), r, init_fn=lambda: state0,
+            grad_fn=grad_fn, apply_fn=apply_fn, batch_fn=batch_fn,
+            sampler=sampler, total_steps=n_steps, cfg=cfg,
+            ckpt_dir=ckpt_dir, audit_samples=True) for r in ranks]
+
+    row = {"model": "elastic", "n_workers": n_workers, "steps": steps}
+
+    # ---- liveness-layer overhead: heartbeats on vs off ----------------
+    def world_wall(heartbeat_s):
+        cfg = ElasticConfig(heartbeat_s=heartbeat_s, watchdog_s=0.5,
+                            step_timeout_s=30.0, join_grace_s=0.1,
+                            snapshot_every=10 ** 9)
+        ws = mk_world(HostKVStore(), list(range(2)), overhead_steps, cfg)
+        t0 = time.perf_counter()
+        run_workers(ws, timeout_s=300)
+        assert all(w.done for w in ws)
+        return time.perf_counter() - t0
+
+    on = min(world_wall(0.02) for _ in range(reps))
+    off = min(world_wall(0.0) for _ in range(reps))
+    row["liveness"] = {
+        "steps": overhead_steps,
+        "wall_on_s": round(on, 4), "wall_off_s": round(off, 4),
+        "steps_per_sec": round(overhead_steps / on, 1),
+        "overhead_frac": round(max(0.0, 1.0 - off / on), 4),
+    }
+
+    # ---- the kill-one-of-N drill --------------------------------------
+    cfg = ElasticConfig(heartbeat_s=0.02, watchdog_s=0.2,
+                        step_timeout_s=5.0, join_grace_s=0.1,
+                        snapshot_every=2)
+    victim_rank, kill_at = n_workers - 2, steps // 2
+    plan = FaultPlan().at(peer_site(victim_rank, "step"), kill_at,
+                          "crash")
+    store = HostKVStore()
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_elastic_")
+    with plan:
+        ws = mk_world(store, list(range(n_workers)), steps, cfg,
+                      ckpt_dir=ckpt_dir)
+        run_workers(ws, timeout_s=300)
+    victim = ws[victim_rank]
+    survivors = [w for w in ws if w.rank != victim_rank]
+    assert all(w.done for w in survivors), "survivors must finish"
+
+    def first(w, name, **match):
+        for n, t, info in w.events:
+            if n == name and all(info.get(k) == v
+                                 for k, v in match.items()):
+                return t
+        return None
+
+    detects = [first(w, "peer_lost") for w in survivors]
+    worlds1 = [first(w, "world", generation=1) for w in survivors]
+    restores = [first(w, "restore") for w in survivors]
+    applied1 = [first(w, "applied", generation=1) for w in survivors]
+    t_dead = victim.stopped_t
+    detect = min(detects) - t_dead
+    reform = max(worlds1) - min(detects)
+    restore = max(restores) - max(worlds1)
+    first_step = max(applied1) - max(restores)
+    # sample-level accounting over what the workers ACTUALLY consumed
+    # (audit_samples logs the fed shard indices): compare the effective
+    # timeline's multiset against the sampler's pure stream per step
+    eff = effective_sample_log(ws)
+    sampler = GlobalBatchSampler(n_ex, gbatch, seed=3)
+    lost = dups = 0
+    for s in range(steps):
+        want = Counter(sampler.batch_indices(s).tolist())
+        got = Counter(eff[s].tolist()) if s in eff else Counter()
+        lost += sum((want - got).values())
+        dups += sum((got - want).values())
+    row["drill"] = {
+        "victim": victim_rank, "kill_at_step": kill_at,
+        "world_after": len(survivors),
+        "detect_s": round(detect, 4),
+        "reform_s": round(reform, 4),
+        "restore_s": round(restore, 4),
+        "first_step_s": round(first_step, 4),
+        "mttr_s": round(max(applied1) - t_dead, 4),
+        "watchdog_s": cfg.watchdog_s,
+        "samples_lost": lost,
+        "samples_double_counted": dups,
+    }
+    return row
+
+
 def bench_obs_pipeline(n_requests: int = 24, new_tokens: int = 24,
                        reps: int = 4) -> dict:
     """Fleet-era observability receipt (ISSUE 11): the SAME serve
@@ -1253,6 +1398,9 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-observability", action="store_true",
                    help="skip the observability-overhead (tracer on vs "
                         "off steps/sec) row")
+    p.add_argument("--skip-elastic", action="store_true",
+                   help="skip the elastic-training row (kill-one-of-N "
+                        "MTTR drill + liveness-layer overhead)")
     p.add_argument("--skip-obs-pipeline", action="store_true",
                    help="skip the serve observability-pipeline row "
                         "(correlated tracing + exporter + SLO eval on "
@@ -1418,6 +1566,18 @@ def main(argv=None) -> dict:
                          "error": f"{type(e).__name__}: {e}"[:200]}
         records.append(fleet_row)
         print("  " + json.dumps(fleet_row), file=sys.stderr, flush=True)
+
+    elastic_row = None
+    if not a.skip_elastic:
+        # elastic row: thread-hosted worker world — kill-one-of-N MTTR
+        # decomposition + liveness-layer overhead receipt (ISSUE 12)
+        try:
+            elastic_row = bench_elastic()
+        except Exception as e:  # the elastic row must never sink the bench
+            elastic_row = {"model": "elastic",
+                           "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(elastic_row)
+        print("  " + json.dumps(elastic_row), file=sys.stderr, flush=True)
 
     ok = [r for r in records if "samples_per_sec" in r]
     # headline = the best-MFU row of the reference-parity model (pyramidnet),
@@ -1591,6 +1751,19 @@ def main(argv=None) -> dict:
         summary["fleet_time_to_evict_s"] = fo.get("time_to_evict_s")
         summary["fleet_requests_retried"] = fo.get("requests_retried")
         summary["fleet_requests_lost"] = fo.get("requests_lost")
+
+    if elastic_row and "error" not in elastic_row:
+        dr = elastic_row.get("drill") or {}
+        summary["elastic_detect_s"] = dr.get("detect_s")
+        summary["elastic_reform_s"] = dr.get("reform_s")
+        summary["elastic_restore_s"] = dr.get("restore_s")
+        summary["elastic_mttr_s"] = dr.get("mttr_s")
+        summary["elastic_samples_lost"] = dr.get("samples_lost")
+        summary["elastic_samples_double_counted"] = \
+            dr.get("samples_double_counted")
+        lv = elastic_row.get("liveness") or {}
+        summary["elastic_liveness_overhead_frac"] = \
+            lv.get("overhead_frac")
 
     full = dict(summary)
     full["records"] = records

@@ -123,10 +123,26 @@ def maybe_slurm(environ=None) -> dict | None:
 
 def sbatch_script(script_args: list[str], nodes: int = 2,
                   ntasks_per_node: int = 1, job_name: str = "dtdl_tpu",
-                  time_limit: str = "01:00:00", partition: str = "") -> str:
+                  time_limit: str = "01:00:00", partition: str = "",
+                  requeue: bool = False, max_restarts: int = 0) -> str:
     """A ready-to-submit sbatch file: one task per host (the JAX
     multi-controller model — each process drives all local TPU chips,
-    unlike the reference's one-process-per-GPU spawn)."""
+    unlike the reference's one-process-per-GPU spawn).
+
+    Two elastic-recovery layers (ISSUE 12; the reference README
+    advertises a SLURM launch it never shipped — this one has the
+    failure model it needs):
+
+    * ``requeue=True`` — ``#SBATCH --requeue`` (+ append-mode logs):
+      node failures and preemptions put the whole job back in the
+      queue; on re-run every rank resumes from its latest checkpoint
+      (the Trainer/Estimator/Solver restore path).
+    * ``max_restarts=N`` — an in-allocation restart loop around
+      ``srun``: a failed step is relaunched up to N times *without*
+      going back through the scheduler queue (the launch.local
+      ``max_restarts`` model, minutes cheaper than a requeue), bounded
+      so a deterministic crash still fails the job loudly.
+    """
     payload = " ".join(shlex.quote(a) for a in script_args)
     lines = [
         "#!/bin/bash",
@@ -137,12 +153,32 @@ def sbatch_script(script_args: list[str], nodes: int = 2,
     ]
     if partition:
         lines.append(f"#SBATCH --partition={partition}")
+    if requeue:
+        lines += [
+            "# requeue-on-failure: preempted/node-failed jobs re-enter",
+            "# the queue and resume from their latest checkpoint",
+            "#SBATCH --requeue",
+            "#SBATCH --open-mode=append",
+        ]
+    srun = f"srun python -m dtdl_tpu.launch.slurm -- {payload}"
     lines += [
         "",
         "# every task self-discovers coordinator/rank from SLURM_* env",
-        f"srun python -m dtdl_tpu.launch.slurm -- {payload}",
-        "",
     ]
+    if max_restarts > 0:
+        lines += [
+            f"# elastic restart: up to {max_restarts} in-allocation",
+            "# relaunches; ranks resume from their latest checkpoint",
+            f"for attempt in $(seq 0 {max_restarts}); do",
+            f"    {srun} && exit 0",
+            "    echo \"[dtdl_tpu.slurm] attempt ${attempt} failed;"
+            " relaunching\" >&2",
+            "done",
+            "exit 1",
+            "",
+        ]
+    else:
+        lines += [srun, ""]
     return "\n".join(lines)
 
 
@@ -156,6 +192,7 @@ def main(argv=None) -> int:
     if argv[:1] == ["--emit-sbatch"]:
         argv = argv[1:]
         nodes, per_node, partition = 2, 1, ""
+        requeue, max_restarts = False, 0
         while argv and argv[0] != "--":
             if argv[0] == "--nodes":
                 nodes = int(argv[1]); argv = argv[2:]
@@ -163,13 +200,18 @@ def main(argv=None) -> int:
                 per_node = int(argv[1]); argv = argv[2:]
             elif argv[0] == "--partition":
                 partition = argv[1]; argv = argv[2:]
+            elif argv[0] == "--requeue":
+                requeue = True; argv = argv[1:]
+            elif argv[0] == "--max-restarts":
+                max_restarts = int(argv[1]); argv = argv[2:]
             else:
                 raise SystemExit(f"unknown flag {argv[0]}")
         script = argv[1:] if argv[:1] == ["--"] else argv
         if not script:
             raise SystemExit("no script given after --")
         print(sbatch_script(script, nodes=nodes, ntasks_per_node=per_node,
-                            partition=partition))
+                            partition=partition, requeue=requeue,
+                            max_restarts=max_restarts))
         return 0
 
     script = argv[1:] if argv[:1] == ["--"] else argv

@@ -44,8 +44,10 @@ from dtdl_tpu.obs.observer import NULL_OBSERVER, Observer  # noqa: F401
 from dtdl_tpu.obs.recompile import (  # noqa: F401
     RecompileError, RecompileEvent, RecompileSentinel,
 )
-from dtdl_tpu.obs.slo import SLO, SLOEvaluator  # noqa: F401
+from dtdl_tpu.obs.slo import (  # noqa: F401
+    SLO, SLOEvaluator, default_train_slos,
+)
 from dtdl_tpu.obs.trace import (  # noqa: F401
     EVENT_CATALOG, NULL_TRACER, SPAN_CATALOG, Tracer, aggregate,
-    xla_events,
+    corr_rid, proc_tag, set_proc_tag, xla_events,
 )

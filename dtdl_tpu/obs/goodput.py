@@ -269,6 +269,8 @@ class GoodputMeter:
         self.roofline_mfu = roofline_mfu
         self.total_steps = 0
         self.total_seconds = 0.0
+        self._exp_steps = 0
+        self._exp_seconds = 0.0
 
     def window(self, steps: int, seconds: float) -> dict:
         """Goodput fields for one settled window (empty if degenerate)."""
@@ -301,3 +303,24 @@ class GoodputMeter:
         if self.total_steps <= 0 or self.total_seconds <= 0:
             return {}
         return self._fields(self.total_steps, self.total_seconds)
+
+    def export_window(self) -> dict:
+        """Delta since the last :meth:`export_window` call — the no-arg
+        source a :class:`~dtdl_tpu.obs.export.MetricsExporter` samples
+        at drain boundaries (register as ``exporter.add_source(
+        "goodput", meter.export_window)``; keys are bare, the source
+        name prefixes them).  Fields cover the steps the loops settled
+        via :meth:`window` in the interval: the per-window goodput set
+        plus ``steps`` and the mean ``step_time_s`` — the gauge
+        ``default_train_slos()`` judges step-time SLOs on.  Empty on an
+        idle interval (the SLO layer's gate skips those)."""
+        dsteps = self.total_steps - self._exp_steps
+        dsecs = self.total_seconds - self._exp_seconds
+        self._exp_steps = self.total_steps
+        self._exp_seconds = self.total_seconds
+        if dsteps <= 0 or dsecs <= 0:
+            return {}
+        out = self._fields(dsteps, dsecs)
+        out["steps"] = dsteps
+        out["step_time_s"] = round(dsecs / dsteps, 6)
+        return out

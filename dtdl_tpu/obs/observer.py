@@ -75,7 +75,7 @@ class Observer:
         containment events).  Host-side only, like every verb here."""
         self.tracer.instant(name, **args)
 
-    def flow(self, name: str, fid: int, phase: str = "step",
+    def flow(self, name: str, fid, phase: str = "step",
              **args) -> None:
         """Chrome-trace flow event (start/step/end) joining spans across
         threads under one correlation id — the serve layers call this
@@ -83,7 +83,7 @@ class Observer:
         as one arrow chain in Perfetto.  No-op when tracing is off."""
         self.tracer.flow(name, fid, phase, **args)
 
-    def request_timeline(self, rid: int) -> list:
+    def request_timeline(self, rid) -> list:
         """All recorded events correlated with user request ``rid``,
         ordered (see :meth:`Tracer.request_timeline`)."""
         return self.tracer.request_timeline(rid)

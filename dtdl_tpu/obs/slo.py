@@ -188,6 +188,39 @@ class SLO:
         return out
 
 
+def default_train_slos(step_time_s: Optional[float] = None,
+                       bad_step_ratio: Optional[float] = None,
+                       window_s: float = 10.0) -> list:
+    """The standard *training* objectives — the twin of
+    ``serve.fleet.default_fleet_slos`` — declared over the fields a
+    trainer-attached exporter samples from ``GoodputMeter.
+    export_window()`` (source name ``"goodput"``) and ``StepGuard.
+    window()`` (source name ``"guard"``):
+
+    * ``step_time_s`` — mean settled step time ≤ the target, judged on
+      ``goodput_step_time_s`` and gated on ``goodput_steps`` so idle
+      windows are skipped;
+    * ``bad_step_ratio`` — the anomalous-step budget: a rolling
+      good/bad ratio over ``guard_good_steps`` / ``guard_bad_steps``
+      with target ``1 - bad_step_ratio`` (e.g. 0.01 tolerates 1% bad
+      steps; a NaN burst burns the budget at the same burn-rate math
+      the serving availability SLO uses).
+    """
+    slos = []
+    if step_time_s is not None:
+        slos.append(SLO("step_time", metric="goodput_step_time_s",
+                        op="<=", target=step_time_s,
+                        gate="goodput_steps"))
+    if bad_step_ratio is not None:
+        if not 0.0 < bad_step_ratio < 1.0:
+            raise ValueError(f"bad_step_ratio must be in (0, 1), got "
+                             f"{bad_step_ratio}")
+        slos.append(SLO("bad_steps", good="guard_good_steps",
+                        bad="guard_bad_steps",
+                        target=1.0 - bad_step_ratio, window_s=window_s))
+    return slos
+
+
 class SLOEvaluator:
     """Evaluates a set of :class:`SLO` objectives on each exported
     series point (attach via :meth:`~dtdl_tpu.obs.export.

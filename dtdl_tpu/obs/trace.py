@@ -114,7 +114,52 @@ EVENT_CATALOG = frozenset({
     "request_first_token", "request_finished", "request_done",
     # SLO layer (round 16)
     "slo_breach", "slo_recovered", "slo_burn_rate",
+    # elastic training plane (round 17): peer detection, world
+    # re-formation, shrink-to-survivors restore, generation fencing —
+    # every abort/fence/shed on the failure path surfaces here, never
+    # as a silent hang
+    "elastic_peer_lost", "elastic_rendezvous", "elastic_restore",
+    "elastic_snapshot", "elastic_stale_fenced", "elastic_step_timeout",
 })
+
+
+# ---------------------------------------------------------------------------
+# correlation ids (round 17): rids are prefixed with a process tag so
+# multi-host traces (and elastic-training events from many workers)
+# merge into one Perfetto view without id collisions — process A's
+# request 7 ("p0/7") can never chain into process B's ("p1/7").
+# ---------------------------------------------------------------------------
+
+_PROC_TAG: str | None = None
+
+
+def proc_tag() -> str:
+    """This process's correlation-id prefix: ``DTDL_PROC_TAG`` when set
+    (a router/launcher naming its workers), else ``p{process_index}``.
+    Cached on first use; override early via :func:`set_proc_tag`."""
+    global _PROC_TAG
+    if _PROC_TAG is None:
+        tag = os.environ.get("DTDL_PROC_TAG")
+        if not tag:
+            import jax
+            tag = f"p{jax.process_index()}"
+        _PROC_TAG = tag
+    return _PROC_TAG
+
+
+def set_proc_tag(tag: str | None) -> None:
+    """Set (or with None, reset) the process tag — call before any
+    correlated event is emitted; changing it mid-trace splits chains."""
+    global _PROC_TAG
+    _PROC_TAG = tag
+
+
+def corr_rid(n) -> str:
+    """The wire form of a correlation id: ``f"{proc_tag}/{n}"``.  Every
+    emitter of a ``rid``/``arid`` arg or a request-flow id goes through
+    here; already-prefixed strings pass through unchanged (the Router
+    stamps attempt clones whose user rid was prefixed at intake)."""
+    return n if isinstance(n, str) else f"{proc_tag()}/{n}"
 
 
 class _Span:
@@ -194,14 +239,15 @@ class Tracer:
 
     _FLOW_PH = {"start": "s", "step": "t", "end": "f"}
 
-    def flow(self, name: str, fid: int, phase: str = "step",
+    def flow(self, name: str, fid, phase: str = "step",
              **args) -> None:
         """A Chrome-trace flow event: ``phase`` is ``start`` / ``step``
         / ``end`` and every event sharing (``name``, ``fid``) is joined
         into one arrow chain across threads — the Perfetto rendering of
         a request's path through router intake, dispatch, and each
         attempt's replica thread.  ``fid`` is the correlation id (the
-        fleet uses the USER request rid)."""
+        fleet uses the USER request rid in its proc-tagged
+        :func:`corr_rid` wire form)."""
         ph = self._FLOW_PH.get(phase)
         if ph is None:
             raise ValueError(f"flow phase must be one of "
@@ -220,7 +266,7 @@ class Tracer:
                 ev["args"] = args
             self._events.append(ev)
 
-    def request_timeline(self, rid: int) -> list[dict]:
+    def request_timeline(self, rid) -> list[dict]:
         """Every recorded event correlated with USER request ``rid``,
         ordered by timestamp — the programmatic reconstruction of one
         request's story across threads, attempts, and failovers.
@@ -229,7 +275,10 @@ class Tracer:
         emitters thread the user rid through attempt clones, so a
         retried/hedged request's sibling attempts all land here, each
         distinguished by its ``arid``/``lineage`` args) or when it is a
-        flow event with ``id == rid``."""
+        flow event with ``id == rid``.  Accepts either the wire form
+        (``"p0/7"``) or a bare local request id, normalized through
+        :func:`corr_rid` — emitters always record the prefixed form."""
+        rid = corr_rid(rid)
         with self._lock:
             events = list(self._events)
         out = [e for e in events

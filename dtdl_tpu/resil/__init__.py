@@ -12,7 +12,13 @@ Three pieces, wired through train/, ckpt/, and serve/:
   rollback-to-last-good / raise policies, lag-harvested through the
   PR-1 MetricsQueue (zero added per-step syncs);
 * :mod:`~dtdl_tpu.resil.preempt` — :class:`PreemptionWatcher`, the
-  SIGTERM → durable snapshot → exact mid-epoch resume path.
+  SIGTERM → durable snapshot → exact mid-epoch resume path;
+* :mod:`~dtdl_tpu.resil.elastic` (ISSUE 12) — the elastic
+  multi-host training plane: heartbeat peer leases, deadline-guarded
+  collectives (:class:`PeerLostError`, never a silent hang),
+  generation-fenced re-rendezvous, and shrink-to-survivors resume
+  from the last committed snapshot, over the host-side control-plane
+  store in :mod:`dtdl_tpu.parallel.kvstore`.
 
 Checkpoint integrity (checksummed msgpack manifests, orbax commit
 markers, corrupt-snapshot quarantine + fallback) lives in
@@ -22,9 +28,15 @@ dtdl_tpu/serve/scheduler.py.  See README "Fault tolerance" and
 SCALING.md "Failure model".
 """
 
+from dtdl_tpu.resil.elastic import (  # noqa: F401
+    ElasticConfig, ElasticWorker, HeartbeatLease, PeerLostError,
+    RendezvousError, StaleGenerationError, StepWatchdog, World,
+    dead_peers, effective_sample_log, exchange_grads, rendezvous,
+    run_workers,
+)
 from dtdl_tpu.resil.faults import (  # noqa: F401
     Fault, FaultPlan, InjectedCrash, InjectedFault, LoaderFaults, fire,
-    poison_batch, replica_site,
+    peer_site, poison_batch, replica_site,
 )
 from dtdl_tpu.resil.guard import (  # noqa: F401
     AnomalousStepError, GuardEscalationError, GuardRollback, StepGuard,

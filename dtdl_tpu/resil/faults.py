@@ -75,6 +75,40 @@ KINDS = ("raise", "crash", "sigterm", "stall", "nan", "blackhole")
 REPLICA_POINTS = ("engine", "loop", "probe")
 
 
+# the elastic-training injection surface (dtdl_tpu/resil/elastic.py):
+# every ElasticWorker fires three sites, so every detection / abort /
+# re-form edge of the training-plane state machine is deterministically
+# reachable —
+#   step      — fired at the top of each training step of worker `rank`
+#               ("crash" at occurrence k == the worker dying right
+#               before exchanging step-k gradients: its heartbeat lease
+#               stops and survivors abort within watchdog_s; "stall"
+#               with `seconds` == a wedged worker whose heartbeat
+#               thread keeps beating but whose gradients never arrive —
+#               the collective/step watchdog path — and whose late
+#               wake-up is then fenced out by generation);
+#   heartbeat — fired on each lease beat of worker `rank` ("stall"
+#               freezes the beats while the main loop runs on: a
+#               partitioned peer whose lease expires);
+#   join      — fired when worker `rank` enters (re-)rendezvous
+#               ("stall" == a late joiner arriving after the quiet
+#               window closed: the formed world excludes it and it is
+#               refused by name).
+PEER_POINTS = ("step", "heartbeat", "join")
+
+
+def peer_site(rank: int, point: str) -> str:
+    """Canonical fault-site name for elastic-training worker ``rank`` —
+    one of the three per-worker injection points above (crash / stall /
+    late-joiner scenarios per the point docs).  Central so tests, the
+    ElasticWorker loop, and FaultPlan schedules can never drift on
+    spelling."""
+    if point not in PEER_POINTS:
+        raise ValueError(f"unknown peer fault point {point!r} "
+                         f"(one of {PEER_POINTS})")
+    return f"peer{rank}.{point}"
+
+
 def replica_site(idx: int, point: str) -> str:
     """Canonical fault-site name for serving-fleet replica ``idx`` —
     one of the three per-replica injection points above.  Central so

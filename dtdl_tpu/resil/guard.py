@@ -97,6 +97,7 @@ class StepGuard:
         self.consecutive = 0
         self.n_rollbacks = 0
         self.last_bad: Optional[dict] = None
+        self._win_prev = {"steps": 0, "bad_steps": 0, "rollbacks": 0}
 
     # ---- the in-jit piece (pure, traceable) --------------------------
 
@@ -163,3 +164,21 @@ class StepGuard:
         """Run-level counters for reports/bench rows."""
         return {"guard_steps": self.n_steps, "guard_bad_steps": self.n_bad,
                 "guard_rollbacks": self.n_rollbacks}
+
+    def window(self) -> dict:
+        """Counter increments since the last :meth:`window` call — the
+        no-arg delta source a :class:`~dtdl_tpu.obs.export.
+        MetricsExporter` samples at drain boundaries (register as
+        ``exporter.add_source("guard", guard.window)``; the source name
+        supplies the ``guard_`` prefix, so keys here are bare).  The
+        derived ``bad_step_ratio`` gauge plus the good/bad counter pair
+        are exactly the fields ``default_train_slos()`` judges — the
+        training twin of the serve ``window()`` sources."""
+        cur = {"steps": self.n_steps, "bad_steps": self.n_bad,
+               "rollbacks": self.n_rollbacks}
+        out = {k: cur[k] - self._win_prev[k] for k in cur}
+        self._win_prev = cur
+        out["good_steps"] = out["steps"] - out["bad_steps"]
+        out["bad_step_ratio"] = (out["bad_steps"] / out["steps"]
+                                 if out["steps"] else 0.0)
+        return out

@@ -15,8 +15,10 @@ from __future__ import annotations
 import atexit
 import logging
 import os
+import random
 import socket
 import threading
+import time
 
 import jax
 
@@ -25,8 +27,22 @@ log = logging.getLogger("dtdl_tpu")
 _initialized = False
 
 
+def backoff_delay(attempt: int, backoff_s: float, max_backoff_s: float,
+                  u: float, jitter: float = 0.5) -> float:
+    """THE backoff formula — ``min(backoff_s·2^attempt, max_backoff_s)``
+    stretched by ``(1 + jitter·u)`` with ``u ∈ [0, 1)`` supplied by the
+    caller's rng (seeded in tests keeps retry schedules deterministic;
+    the jitter de-syncs a herd of workers retrying together).  Shared
+    by the rendezvous retry in :func:`initialize` and the host-store
+    ``RetryingStore`` so tuning cannot drift between them."""
+    return min(backoff_s * (2 ** attempt), max_backoff_s) * \
+        (1.0 + jitter * u)
+
+
 def initialize(coordinator: str = "", num_processes: int = 1,
-               process_id: int = 0, local_device_ids=None) -> None:
+               process_id: int = 0, local_device_ids=None,
+               retries: int = 0, backoff_s: float = 1.0,
+               max_backoff_s: float = 15.0) -> None:
     """Join (or create) the multi-process cluster.
 
     No-op for single-process runs — a plain ``python script.py`` works with no
@@ -34,6 +50,13 @@ def initialize(coordinator: str = "", num_processes: int = 1,
     multi-process, every host calls this with the same coordinator address
     (host:port of process 0) and its own ``process_id``; it subsumes the
     reference's rank/world-size/init-method flag trio and TF_CONFIG.
+
+    ``retries`` bounds re-attempts of the rendezvous itself: a restarted
+    worker routinely races the coordinator coming back up (the elastic
+    requeue path, ISSUE 12), so connection failures are retried with
+    exponential backoff plus jitter — bounded, so a permanently absent
+    coordinator still fails loudly with the original error instead of
+    retrying forever.
     """
     global _initialized
     if num_processes <= 1 and not coordinator:
@@ -47,14 +70,28 @@ def initialize(coordinator: str = "", num_processes: int = 1,
     kwargs = {}
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
-    log.info("rendezvous: coordinator=%s process %d/%d (host %s)",
-             coordinator, process_id, num_processes, socket.gethostname())
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
-    )
+    for attempt in range(retries + 1):
+        log.info("rendezvous: coordinator=%s process %d/%d (host %s)%s",
+                 coordinator, process_id, num_processes,
+                 socket.gethostname(),
+                 f" [attempt {attempt + 1}/{retries + 1}]" if retries
+                 else "")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+            break
+        except Exception as e:
+            if attempt >= retries:
+                raise
+            delay = backoff_delay(attempt, backoff_s, max_backoff_s,
+                                  random.random())
+            log.warning("rendezvous attempt %d failed (%s); retrying "
+                        "in %.2fs", attempt + 1, e, delay)
+            time.sleep(delay)
     _initialized = True
     atexit.register(_shutdown)
 

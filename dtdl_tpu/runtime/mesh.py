@@ -126,6 +126,33 @@ def hybrid_mesh(ici_shape: tuple[int, ...] | None = None,
     return Mesh(dev_array, (dcn_axis,) + tuple(ici_axes))
 
 
+def shrink_mesh(mesh: Mesh, survivors, axis: str = DATA_AXIS) -> Mesh:
+    """Rebuild ``mesh`` keeping only the ``survivors`` coordinates along
+    ``axis`` — the device-plane half of shrink-to-survivors elastic
+    training (ISSUE 12): after peers are lost, the new world's data axis
+    spans exactly the surviving positions, every other axis keeps its
+    full extent, and collectives compile against the smaller world
+    instead of hanging on ghosts.
+
+    ``survivors`` are axis *coordinates* (positions along ``axis``), not
+    device ids — the same indexing the data layer's shard positions use.
+    """
+    names = mesh.axis_names
+    if axis not in names:
+        raise ValueError(f"mesh has no axis {axis!r} (axes: {names})")
+    ax = names.index(axis)
+    extent = mesh.devices.shape[ax]
+    surv = sorted(set(int(s) for s in survivors))
+    if not surv:
+        raise ValueError("shrink_mesh needs at least one survivor")
+    bad = [s for s in surv if not 0 <= s < extent]
+    if bad:
+        raise ValueError(
+            f"survivor position(s) {bad} outside axis {axis!r} of "
+            f"extent {extent}")
+    return Mesh(np.take(mesh.devices, surv, axis=ax), names)
+
+
 def local_mesh(axes: tuple[str, ...] = (DATA_AXIS,)) -> Mesh:
     """Mesh over this process's local devices only.
 

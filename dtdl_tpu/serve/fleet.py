@@ -70,6 +70,7 @@ from typing import Optional, Sequence
 from dtdl_tpu.obs.hist import LogHistogram
 from dtdl_tpu.obs.observer import NULL_OBSERVER
 from dtdl_tpu.obs.slo import SLO, SLOEvaluator
+from dtdl_tpu.obs.trace import corr_rid
 from dtdl_tpu.resil.faults import FaultPlan, InjectedFault, replica_site
 from dtdl_tpu.serve.health import (DRAINING, EVICTED, HEALTHY, SUSPECT,
                                    ReplicaHealth)
@@ -707,10 +708,10 @@ class Router:
             # submit event's timestamp always precedes the dispatch
             # event's and the timeline/flow chain reads in causal order
             # (the tracer lock is a leaf; no ordering cycle).
-            self.observer.event("request_submitted", rid=req.rid,
+            self.observer.event("request_submitted", rid=corr_rid(req.rid),
                                 prompt_len=len(req.prompt),
                                 max_new_tokens=req.max_new_tokens)
-            self.observer.flow("req", req.rid, "start")
+            self.observer.flow("req", corr_rid(req.rid), "start")
             self.queue.append(fl)
             self._cv.notify_all()
         return req
@@ -726,7 +727,7 @@ class Router:
         # intake-time rejection: the request never started a flow chain
         # (request_submitted/flow-start are for ACCEPTED requests), so
         # only the terminal marker is emitted — no dangling flow end
-        self.observer.event("request_done", rid=req.rid,
+        self.observer.event("request_done", rid=corr_rid(req.rid),
                             kind=error_kind(error), attempts=0)
         self._cv.notify_all()
         return req
@@ -769,12 +770,12 @@ class Router:
         # many were ever dispatched, and the outcome kind — the last
         # entry of request_timeline(rid), closing the flow chain
         self.observer.event(
-            "request_done", rid=user.rid,
+            "request_done", rid=corr_rid(user.rid),
             kind=error_kind(user.error) if user.error else "finished",
             attempts=len(fl.attempts), retries=fl.retries,
             hedged=int(fl.hedged),
-            **({"arid": attempt.rid} if attempt is not None else {}))
-        self.observer.flow("req", user.rid, "end")
+            **({"arid": corr_rid(attempt.rid)} if attempt is not None else {}))
+        self.observer.flow("req", corr_rid(user.rid), "end")
         for rid, j in losers:
             # best-effort: a loser past cancellation finishes on its
             # replica and is dropped at collection (user already done)
@@ -838,8 +839,8 @@ class Router:
             self.health[i].on_success()
             if fl.hedged and att.rid == fl.hedge_rid and not user.done:
                 self.metrics.on_hedge_won()
-                self.observer.event("hedge_won", rid=user.rid,
-                                    arid=att.rid, replica=i)
+                self.observer.event("hedge_won", rid=corr_rid(user.rid),
+                                    arid=corr_rid(att.rid), replica=i)
             self._finish_user(fl, None, None, attempt=att)
             return
         kind = error_kind(att.error)
@@ -908,7 +909,8 @@ class Router:
             return
         fl.retries += 1
         self.metrics.on_retry()
-        self.observer.event("request_retry", rid=user.rid, n=fl.retries)
+        self.observer.event("request_retry", rid=corr_rid(user.rid),
+                            n=fl.retries)
         with self._cv:
             self.queue.appendleft(fl)
             self._cv.notify_all()
@@ -1127,10 +1129,11 @@ class Router:
                             self.metrics.on_failed)
                     return
                 self.observer.event("request_dispatched",
-                                    rid=fl.req.rid, arid=att.rid,
+                                    rid=corr_rid(fl.req.rid),
+                                    arid=corr_rid(att.rid),
                                     replica=target, lineage=att.lineage,
                                     retries=fl.retries)
-                self.observer.flow("req", fl.req.rid, "step")
+                self.observer.flow("req", corr_rid(fl.req.rid), "step")
                 self.replicas[target].submit(att)
 
     def _clone(self, user: Request, lineage: str = "primary") -> Request:
@@ -1176,9 +1179,10 @@ class Router:
             # the hedge IS this flight's second dispatch: one event with
             # the sibling-attempt correlation (rid joins it to the
             # primary, arid/lineage tell the attempts apart)
-            self.observer.event("request_hedged", rid=rid, arid=att.rid,
+            self.observer.event("request_hedged", rid=corr_rid(rid),
+                                arid=corr_rid(att.rid),
                                 replica=j, lineage="hedge")
-            self.observer.flow("req", rid, "step")
+            self.observer.flow("req", corr_rid(rid), "step")
             self.replicas[j].submit(att)
 
     # ---- lifecycle ----------------------------------------------------

@@ -86,6 +86,7 @@ import jax
 import numpy as np
 
 from dtdl_tpu.obs.observer import NULL_OBSERVER
+from dtdl_tpu.obs.trace import corr_rid
 from dtdl_tpu.serve.draft import DraftSource, NGramDraft
 from dtdl_tpu.serve.engine import InferenceEngine, PromptTooLongError
 from dtdl_tpu.serve.metrics import ERROR_KINDS, ServeMetrics
@@ -342,9 +343,11 @@ class Scheduler:
         ``arid`` the local attempt id — so
         ``Tracer.request_timeline(rid)`` collects every attempt's
         events under the one user rid while ``arid`` tells the sibling
-        attempts apart."""
+        attempts apart.  Both land in the wire form (``corr_rid``:
+        ``f"{proc_tag}/{n}"``, round 17) so multi-host traces merge
+        without id collisions."""
         rid = req.origin_rid if req.origin_rid is not None else req.rid
-        return {"rid": rid, "arid": req.rid}
+        return {"rid": corr_rid(rid), "arid": corr_rid(req.rid)}
 
     def _finish_error(self, req: Request, reason: str,
                       metric_hook, kind: str) -> Request:
@@ -367,7 +370,7 @@ class Scheduler:
             # so close it (never-admitted requests started none, and
             # fleet attempts' chains are closed by the Router's
             # request_done, which owns the user-level outcome)
-            self.observer.flow("req", req.rid, "end")
+            self.observer.flow("req", corr_rid(req.rid), "end")
         return req
 
     def _reject(self, req: Request, reason: str) -> Request:

@@ -81,7 +81,7 @@ class Trainer:
     def __init__(self, state, train_step, train_loader, strategy: Strategy,
                  stop_trigger=(20, "epoch"), out: str = "./result",
                  prefetch: int = 2, metrics_lag: int = 20, observer=None,
-                 guard=None, preempt=None):
+                 guard=None, preempt=None, exporter=None, watchdog=None):
         self.state = state
         self.train_step = train_step
         # obs facade (dtdl_tpu.obs): spans + recompile sentinel + goodput;
@@ -97,6 +97,17 @@ class Trainer:
         self.guard = guard
         self.preempt = preempt
         self.preempted = False
+        # continuous-export wiring (round 17): a MetricsExporter is
+        # sampled at the drain boundary — the one boundary this loop
+        # already owns — so training series/SLOs (default_train_slos
+        # over GoodputMeter.export_window / StepGuard.window sources)
+        # cost zero added syncs, exactly like the serve pipeline
+        self.exporter = exporter
+        # elastic step watchdog (round 17): a resil.elastic.StepWatchdog
+        # bounds the drain's host↔device wait — a dead peer inside a
+        # shard_map collective surfaces as a named PeerLostError at the
+        # next drain instead of hanging this host forever
+        self.watchdog = watchdog
         self.train_loader = train_loader
         self.strategy = strategy
         self.stop = Trigger.of(stop_trigger)
@@ -146,7 +157,9 @@ class Trainer:
         old sync-every-iteration loop produced.
         """
         with self.observer.span("drain"):
-            drained = self.metrics_queue.drain()
+            drained = (self.watchdog.run(self.metrics_queue.drain)
+                       if self.watchdog is not None
+                       else self.metrics_queue.drain())
         for vals in drained:
             if self.guard is not None:
                 self.guard.observe(vals)
@@ -158,6 +171,8 @@ class Trainer:
             # land in observation so LogReport/PrintReport can select them
             self.observation.update(self.observer.window(
                 len(drained), self.timer.last_step_s * len(drained)))
+        if self.exporter is not None:
+            self.exporter.sample()
 
     # -- run loop -------------------------------------------------------------
 
@@ -173,6 +188,10 @@ class Trainer:
             # snapshots save asynchronously; make them durable before the
             # process moves on (a fresh Trainer may resume immediately)
             self.ckpt.wait_until_finished()
+            if self.exporter is not None:
+                # the forced final point closes the window-delta
+                # telescope even on an exception path
+                self.exporter.sample(force=True)
 
     def _run(self) -> None:
         step_fn = self.observer.watch(self.train_step, "trainer.train_step")

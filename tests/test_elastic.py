@@ -1,0 +1,434 @@
+"""Elastic multi-host training (ISSUE 12): peer liveness, collective
+watchdogs, generation-fenced re-rendezvous, shrink-to-survivors resume.
+
+The contracts:
+
+1. **detection, never a silent hang** — a crashed peer's heartbeat
+   lease expires and survivors abort the step within ``watchdog_s``
+   with a named :class:`PeerLostError`; a wedged peer (lease fresh,
+   gradients absent) trips the step deadline instead.  Every edge is
+   injected deterministically through ``peer_site``.
+2. **THE e2e drill** — 4 workers train; ``peer_site`` kills one
+   mid-epoch; survivors detect, re-form at world 3 under a new
+   generation, restore the last committed snapshot, and the final
+   params are **bitwise equal** to a fault-free 3-worker run restored
+   from the same snapshot — with zero samples lost or double-counted
+   across the shrink (the effective-timeline audit), and every
+   failure-path event landing on the trace by cataloged name.
+3. **generation fencing** — a stalled (not crashed) peer waking after
+   the new world formed is refused by a named
+   :class:`StaleGenerationError` instead of corrupting the new world.
+4. the satellites: world-size-agnostic batch sharding, shrink_mesh,
+   the StepWatchdog for plain shard_map loops, and training SLOs over
+   the GoodputMeter/StepGuard exporter sources.
+"""
+
+import functools
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dtdl_tpu.data.sharding import GlobalBatchSampler, elastic_global_batch
+from dtdl_tpu.models import MLP
+from dtdl_tpu.obs import (MetricsExporter, Observer, SLOEvaluator,
+                          default_train_slos)
+from dtdl_tpu.obs.goodput import GoodputMeter
+from dtdl_tpu.parallel.kvstore import HostKVStore, RetryingStore
+from dtdl_tpu.resil import (ElasticConfig, ElasticWorker, FaultPlan,
+                            PeerLostError, RendezvousError,
+                            StaleGenerationError, StepGuard, StepWatchdog,
+                            World, dead_peers, effective_sample_log,
+                            exchange_grads, peer_site, rendezvous,
+                            run_workers)
+from dtdl_tpu.resil.elastic import HeartbeatLease
+from dtdl_tpu.runtime.mesh import build_mesh, shrink_mesh
+from dtdl_tpu.train import init_state
+
+# ---------------------------------------------------------------------------
+# the shared tiny training problem (one compile per module)
+# ---------------------------------------------------------------------------
+
+N, DIM, GLOBAL_BATCH, STEPS = 48, 16, 12, 8
+_RNG = np.random.default_rng(0)
+X = _RNG.normal(size=(N, DIM)).astype(np.float32)
+Y = _RNG.integers(0, 10, N)
+MODEL = MLP(n_units=8)
+
+
+@functools.lru_cache(maxsize=None)
+def _state0():
+    return init_state(MODEL, jax.random.PRNGKey(0),
+                      jnp.zeros((1, DIM)), optax.sgd(0.1))
+
+
+def init_fn():
+    # immutable pytree: workers can share one template (init_state jits
+    # a fresh build closure per call — a ~1s recompile that would eat
+    # into the drill's step deadline on every restore)
+    return _state0()
+
+
+def _loss(params, batch):
+    logits = MODEL.apply({"params": params}, batch["x"])
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["y"]).mean()
+
+
+@functools.lru_cache(maxsize=None)
+def _jits():
+    grad = jax.jit(lambda p, b: jax.grad(_loss)(p, b))
+    apply = jax.jit(lambda s, g, n: s.apply_gradients(
+        grads=jax.tree.map(lambda x: x / n, g)))
+    return grad, apply
+
+
+def grad_fn(state, batch):
+    return _jits()[0](state.params, batch)
+
+
+def apply_fn(state, grads, world_size):
+    return _jits()[1](state, grads, float(world_size))
+
+
+def batch_fn(idx):
+    return {"x": jnp.asarray(X[idx]), "y": jnp.asarray(Y[idx])}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm():
+    """Compile the drill's grad/apply programs once up front: a first
+    compile inside a worker thread is indistinguishable from a wedge to
+    the step deadline (the same lesson the fleet Router learned —
+    PR 9 warms its engine before arming the watchdog)."""
+    s = _state0()
+    g = jax.device_get(grad_fn(s, batch_fn(np.arange(4))))
+    apply_fn(s, g, 3)
+
+
+def mk_cfg(**over):
+    base = dict(heartbeat_s=0.03, watchdog_s=0.25, step_timeout_s=2.0,
+                join_grace_s=0.2, rendezvous_timeout_s=8.0,
+                snapshot_every=2)
+    base.update(over)
+    return ElasticConfig(**base)
+
+
+def mk_workers(store, ranks, ckpt_dir=None, cfg=None, steps=STEPS,
+               observer=None):
+    sampler = GlobalBatchSampler(N, GLOBAL_BATCH, seed=3)
+    return [ElasticWorker(RetryingStore(store), r, init_fn=init_fn,
+                          grad_fn=grad_fn, apply_fn=apply_fn,
+                          batch_fn=batch_fn, sampler=sampler,
+                          total_steps=steps, cfg=cfg or mk_cfg(),
+                          ckpt_dir=ckpt_dir, observer=observer,
+                          audit_samples=True)
+            for r in ranks]
+
+
+def marks(worker, name):
+    return [(t, info) for n, t, info in worker.events if n == name]
+
+
+# ---------------------------------------------------------------------------
+# satellites: sharding, mesh, watchdog, liveness primitives
+# ---------------------------------------------------------------------------
+
+def test_global_batch_sampler_is_world_size_agnostic():
+    """The global order is a pure function of (seed, step); worker
+    slices concatenate back to exactly the global batch for EVERY world
+    size — the zero-lost/zero-dup property shrink relies on."""
+    s = GlobalBatchSampler(N, GLOBAL_BATCH, seed=7)
+    for step in (0, 3, 5, 9):      # crosses the epoch boundary (4/epoch)
+        batch = s.batch_indices(step)
+        assert len(batch) == GLOBAL_BATCH
+        for world in (1, 2, 3, 4):
+            shards = [s.shard(step, i, world) for i in range(world)]
+            np.testing.assert_array_equal(np.concatenate(shards), batch)
+    # distinct epochs reshuffle; same epoch is stable
+    assert not np.array_equal(s.batch_indices(0), s.batch_indices(4))
+    np.testing.assert_array_equal(s.batch_indices(2), s.batch_indices(2))
+    # divisibility is enforced by name at rendezvous time
+    with pytest.raises(ValueError, match="does not divide"):
+        s.check_world(5)
+    assert elastic_global_batch(4) == 12       # lcm(1..4)
+    assert elastic_global_batch(4, per_worker=2) == 24
+
+
+def test_shrink_mesh_keeps_survivor_positions(devices):
+    mesh = build_mesh()
+    small = shrink_mesh(mesh, [0, 2, 5])
+    assert small.shape["data"] == 3
+    assert list(small.devices.ravel()) == [devices[0], devices[2],
+                                           devices[5]]
+    with pytest.raises(ValueError, match="at least one survivor"):
+        shrink_mesh(mesh, [])
+    with pytest.raises(ValueError, match="outside axis"):
+        shrink_mesh(mesh, [0, 11])
+    with pytest.raises(ValueError, match="no axis"):
+        shrink_mesh(mesh, [0], axis="pipe")
+
+
+def test_peer_site_spelling():
+    assert peer_site(3, "step") == "peer3.step"
+    assert peer_site(0, "heartbeat") == "peer0.heartbeat"
+    with pytest.raises(ValueError, match="unknown peer fault point"):
+        peer_site(0, "crash")
+
+
+def test_heartbeat_lease_and_dead_peers():
+    store = HostKVStore()
+    lease = HeartbeatLease(store, 0, heartbeat_s=0.02).start()
+    try:
+        assert dead_peers(store, [0], watchdog_s=0.2) == ()
+        # a rank that never beat is dead from the start
+        assert dead_peers(store, [0, 7], watchdog_s=0.2) == (7,)
+    finally:
+        lease.stop()
+    time.sleep(0.25)
+    assert dead_peers(store, [0], watchdog_s=0.2) == (0,)
+
+
+def test_step_watchdog_names_the_hang():
+    wd = StepWatchdog(timeout_s=0.15, name="drain")
+    assert wd.run(lambda: 41 + 1) == 42          # pass-through
+    with pytest.raises(ZeroDivisionError):       # errors propagate
+        wd.run(lambda: 1 // 0)
+    with pytest.raises(PeerLostError, match="drain did not settle"):
+        wd.run(time.sleep, 0.6)
+    assert wd.n_timeouts == 1
+
+
+def test_exchange_deadline_names_the_missing_peer():
+    """Wedged-peer path: lease checks off, the other rank never posts —
+    the step aborts at the deadline naming exactly the missing rank."""
+    store = HostKVStore()
+    world = World(0, (0, 1), 0)
+    cfg = mk_cfg(heartbeat_s=0, step_timeout_s=0.2, poll_s=0.02)
+    grads = {"w": np.ones(2, np.float32)}
+    with pytest.raises(PeerLostError) as ei:
+        exchange_grads(store, world, 0, grads, cfg)
+    assert ei.value.lost == (1,)
+    assert "deadline" in str(ei.value)
+
+
+def test_exchange_sums_in_rank_order():
+    store = HostKVStore()
+    cfg = mk_cfg(heartbeat_s=0)
+    outs = {}
+
+    def member(rank):
+        w = World(0, (0, 1, 2), rank)
+        outs[rank] = exchange_grads(
+            store, w, 0, {"g": np.full(2, float(rank + 1), np.float32)},
+            cfg)
+
+    ts = [threading.Thread(target=member, args=(r,)) for r in range(3)]
+    [t.start() for t in ts]
+    [t.join(10) for t in ts]
+    for r in range(3):
+        np.testing.assert_array_equal(outs[r]["g"],
+                                      np.full(2, 6.0, np.float32))
+
+
+def test_trainer_drain_rides_the_watchdog(tmp_path):
+    """Trainer(watchdog=...) bounds the drain's host↔device wait: a
+    wedged collective surfaces as the named PeerLostError at the next
+    drain instead of hanging this host forever."""
+    from dtdl_tpu.parallel.strategy import SingleDevice
+    from dtdl_tpu.train import Trainer
+    tr = Trainer(None, lambda s, b: (s, {}), None, SingleDevice(),
+                 out=str(tmp_path), watchdog=StepWatchdog(0.1))
+    tr.metrics_queue.drain = lambda: time.sleep(0.5)   # the wedge
+    with pytest.raises(PeerLostError, match="did not settle"):
+        tr._drain_metrics()
+    # and a healthy drain passes through untouched
+    tr.metrics_queue.drain = lambda: []
+    tr._drain_metrics()
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: formation, min_world, bootstrap fencing
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_forms_world_and_fences_late_bootstrap_joiner():
+    store = HostKVStore()
+    cfg = mk_cfg(join_grace_s=0.1, rendezvous_timeout_s=5.0)
+    got = {}
+
+    def join(rank):
+        got[rank] = rendezvous(store, rank, cfg)
+
+    ts = [threading.Thread(target=join, args=(r,)) for r in (0, 1)]
+    [t.start() for t in ts]
+    [t.join(10) for t in ts]
+    assert got[0].ranks == got[1].ranks == (0, 1)
+    assert got[0].generation == 0
+    assert got[0].is_leader and not got[1].is_leader
+    assert (got[0].index, got[1].index) == (0, 1)
+    # a worker arriving after bootstrap closed is refused BY NAME — it
+    # cannot silently grow (or hang) the formed world
+    with pytest.raises(StaleGenerationError, match="fenced out"):
+        rendezvous(store, 2, cfg)
+
+
+def test_rendezvous_below_min_world_fails_by_name():
+    store = HostKVStore()
+    cfg = mk_cfg(min_world=2, join_grace_s=0.05,
+                 rendezvous_timeout_s=0.4)
+    with pytest.raises(RendezvousError, match="min_world"):
+        rendezvous(store, 0, cfg)
+
+
+# ---------------------------------------------------------------------------
+# training SLOs over the exporter sources (PR 11 known-remaining)
+# ---------------------------------------------------------------------------
+
+def test_train_slos_over_guard_and_goodput_window_sources():
+    """GoodputMeter/StepGuard plug into a MetricsExporter exactly like
+    the serve window() sources, and default_train_slos judges step-time
+    and bad-step-ratio on the exported points."""
+    guard = StepGuard(policy="skip", max_consecutive=100)
+    meter = GoodputMeter(tokens_per_step=10, peak_flops=None)
+    exporter = MetricsExporter(interval_s=0.0)
+    exporter.add_source("guard", guard.window)
+    exporter.add_source("goodput", meter.export_window)
+    exporter.attach_slo(SLOEvaluator(default_train_slos(
+        step_time_s=0.5, bad_step_ratio=0.25, window_s=10.0)))
+
+    meter.window(4, 0.4)                       # 0.1 s/step: healthy
+    for _ in range(4):
+        guard.observe({"bad_step": 0.0})
+    p1 = exporter.sample(force=True)
+    assert p1["guard_steps"] == 4 and p1["guard_bad_steps"] == 0
+    assert p1["goodput_steps"] == 4
+    assert p1["goodput_step_time_s"] == pytest.approx(0.1)
+    assert p1["slo_step_time_ok"] == 1
+    assert p1["slo_bad_steps_ok"] == 1
+
+    # a NaN burst + a straggler window: both objectives breach, and the
+    # window deltas cover only what happened since the last sample
+    meter.window(2, 2.0)                       # 1.0 s/step
+    guard.observe({"bad_step": 1.0})
+    guard.observe({"bad_step": 1.0})
+    p2 = exporter.sample(force=True)
+    assert p2["guard_steps"] == 2 and p2["guard_bad_steps"] == 2
+    assert p2["guard_bad_step_ratio"] == 1.0
+    assert p2["slo_step_time_ok"] == 0
+    assert p2["slo_bad_steps_ok"] == 0
+    assert p2["slo_bad_steps_burn"] > 1.0
+    # idle window: goodput fields absent (gate), guard deltas zero
+    p3 = exporter.sample(force=True)
+    assert "goodput_step_time_s" not in p3
+    assert "slo_step_time_ok" not in p3        # gated, not judged
+    assert p3["guard_steps"] == 0
+    # cumulative books untouched by windowing
+    assert guard.summary()["guard_bad_steps"] == 2
+    assert meter.totals()["tokens_per_sec"] > 0
+    exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# THE e2e drills (acceptance): kill-one-of-4, stall-and-fence
+# ---------------------------------------------------------------------------
+
+@pytest.mark.elastic
+@pytest.mark.faults
+def test_e2e_kill_one_of_four_shrinks_bitwise_exact(tmp_path):
+    """4 workers; peer_site kills rank 2 mid-epoch.  Survivors detect
+    within watchdog_s (lease-driven — well before the step deadline),
+    re-form at world 3 under generation 1, restore the last committed
+    snapshot, and finish.  Final params are bitwise equal to a
+    fault-free 3-worker run restored from the same snapshot; the
+    effective timeline consumed every global batch exactly once."""
+    cfg = mk_cfg()
+    obs = Observer(trace=True, sentinel=None)
+    plan = FaultPlan().at(peer_site(2, "step"), 5, "crash")
+    store = HostKVStore()
+    with plan:
+        ws = mk_workers(store, [0, 1, 2, 3], ckpt_dir=str(tmp_path),
+                        cfg=cfg, observer=obs)
+        run_workers(ws, timeout_s=60)
+    assert plan.log == [(peer_site(2, "step"), 5, "crash")]
+    victim, survivors = ws[2], [ws[0], ws[1], ws[3]]
+    assert not victim.done and victim.error is not None
+    for w in survivors:
+        assert w.done and w.error is None
+        assert w.world.generation == 1 and w.world.ranks == (0, 1, 3)
+
+    # detection: within watchdog_s of the victim's death (+ scheduling
+    # slack), and far inside the step deadline — lease-driven, and at
+    # least one survivor NAMED the dead rank
+    detect = [marks(w, "peer_lost")[0][0] - victim.stopped_t
+              for w in survivors]
+    assert max(detect) < cfg.watchdog_s + 0.75
+    assert max(detect) < cfg.step_timeout_s
+    named = set()
+    for w in survivors:
+        named |= set(marks(w, "peer_lost")[0][1]["lost"])
+    assert named == {2}
+
+    # the failure path is fully evented, by cataloged name
+    names = {e["name"] for e in obs.tracer.to_chrome()["traceEvents"]
+             if e.get("ph") == "i"}
+    assert {"elastic_peer_lost", "elastic_rendezvous",
+            "elastic_restore", "elastic_snapshot"} <= names
+
+    # fault-free world-3 run restored from the SAME committed snapshot
+    restored = marks(survivors[0], "restore")[0][1]["step"]
+    path = os.path.join(str(tmp_path), f"elastic_{restored:06d}.msgpack")
+    assert os.path.exists(path) and 0 < restored < STEPS
+    store_b = HostKVStore()
+    store_b.set("ckpt/committed", {"step": restored, "path": path})
+    ws_b = mk_workers(store_b, [0, 1, 3])
+    run_workers(ws_b, timeout_s=60)
+    for a, b in zip(survivors, ws_b):
+        assert b.done
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)),
+            jax.device_get(a.state.params), jax.device_get(b.state.params))
+
+    # zero samples lost, zero double-counted: the union of the shard
+    # indices the workers ACTUALLY consumed along the effective
+    # timeline is exactly the sampler's pure stream, as a multiset —
+    # a dropped or double-consumed index would break the comparison
+    # (the consumed-side log is what makes this audit falsifiable)
+    eff = effective_sample_log(ws)
+    sampler = GlobalBatchSampler(N, GLOBAL_BATCH, seed=3)
+    assert sorted(eff) == list(range(STEPS))
+    for step, consumed in eff.items():
+        np.testing.assert_array_equal(
+            consumed, np.sort(sampler.batch_indices(step)))
+
+
+@pytest.mark.elastic
+@pytest.mark.faults
+def test_e2e_stalled_peer_wakes_late_and_is_fenced_by_name(tmp_path):
+    """A stalled (not crashed) peer: its heartbeat thread keeps the
+    lease fresh, so survivors detect via the STEP deadline, re-form
+    without it — and when it wakes it is refused by a named
+    StaleGenerationError instead of corrupting the new world."""
+    cfg = mk_cfg(step_timeout_s=0.6)
+    obs = Observer(trace=True, sentinel=None)
+    plan = FaultPlan().at(peer_site(1, "step"), 3, "stall", seconds=2.0)
+    store = HostKVStore()
+    with plan:
+        ws = mk_workers(store, [0, 1, 2], ckpt_dir=str(tmp_path),
+                        cfg=cfg, steps=6, observer=obs)
+        run_workers(ws, timeout_s=60)
+    staller, survivors = ws[1], [ws[0], ws[2]]
+    for w in survivors:
+        assert w.done and w.error is None
+        assert w.world.ranks == (0, 2) and w.world.generation == 1
+    assert staller.fenced and not staller.done
+    assert isinstance(staller.error, StaleGenerationError)
+    assert "fenced out" in str(staller.error)
+    names = {e["name"] for e in obs.tracer.to_chrome()["traceEvents"]
+             if e.get("ph") == "i"}
+    assert "elastic_stale_fenced" in names

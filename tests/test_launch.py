@@ -63,6 +63,32 @@ def test_discover_workers_env(monkeypatch):
     assert discover_workers() == ["localhost"]
 
 
+def test_initialize_retries_transient_rendezvous_failures(monkeypatch):
+    """A restarted worker racing the coordinator retries the rendezvous
+    with bounded backoff (ISSUE 12) — and a permanently absent
+    coordinator still fails with the original error, loudly."""
+    from dtdl_tpu.runtime import bootstrap
+    calls = {"n": 0}
+
+    def flaky(**kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(bootstrap, "_initialized", False)
+    monkeypatch.setattr(bootstrap.jax.distributed, "initialize", flaky)
+    monkeypatch.setattr(bootstrap.atexit, "register", lambda fn: None)
+    bootstrap.initialize("127.0.0.1:1", 2, 0, retries=4, backoff_s=0.001)
+    assert calls["n"] == 3
+    # bounded: the budget exhausts into the underlying error
+    monkeypatch.setattr(bootstrap, "_initialized", False)
+    calls["n"] = -100                      # always fails within budget
+    with pytest.raises(RuntimeError, match="connection refused"):
+        bootstrap.initialize("127.0.0.1:1", 2, 0, retries=2,
+                             backoff_s=0.001)
+    monkeypatch.setattr(bootstrap, "_initialized", False)
+
+
 def test_local_launcher_elastic_restart(tmp_path, capfd):
     """max_restarts relaunches the whole world after a failure; the retry
     succeeds (checkpoint-restart elasticity beyond the reference's

@@ -42,7 +42,8 @@ from dtdl_tpu.models.transformer import transformer_lm
 from dtdl_tpu.obs import (JsonlSeriesSink, MetricsExporter, Observer,
                           PrometheusSink, SLO, SLOEvaluator, Tracer,
                           prometheus_text)
-from dtdl_tpu.obs.trace import EVENT_CATALOG, SPAN_CATALOG
+from dtdl_tpu.obs.trace import (EVENT_CATALOG, SPAN_CATALOG, corr_rid,
+                                proc_tag)
 from dtdl_tpu.resil import FaultPlan
 from dtdl_tpu.resil.faults import replica_site
 from dtdl_tpu.serve import (ERROR_KINDS, FleetMetrics, InferenceEngine,
@@ -387,17 +388,20 @@ def test_scheduler_request_timeline_and_receipts(engine, oracle):
     for a, b in (("request_admitted", "request_first_token"),
                  ("request_first_token", "request_finished")):
         assert names.index(a) < names.index(b), names
-    # correlation args: standalone requests are their own origin
+    # correlation args: standalone requests are their own origin,
+    # and rids land in the proc-tagged wire form (round 17) so
+    # multi-host traces merge without collisions
     admitted = next(e for e in tl if e["name"] == "request_admitted")
-    assert admitted["args"]["rid"] == reqs[0].rid
-    assert admitted["args"]["arid"] == reqs[0].rid
+    assert admitted["args"]["rid"] == corr_rid(reqs[0].rid)
+    assert admitted["args"]["arid"] == corr_rid(reqs[0].rid)
+    assert admitted["args"]["rid"].startswith(proc_tag() + "/")
     assert admitted["args"]["lineage"] == "primary"
     # flow chain: a start and an end for this rid
     flows = [e for e in tl if e.get("cat") == "request"]
     assert [f["ph"] for f in flows][0] == "s"
     assert [f["ph"] for f in flows][-1] == "f"
     # another request's timeline never bleeds in
-    assert all(e["args"]["rid"] == reqs[0].rid
+    assert all(e["args"]["rid"] == corr_rid(reqs[0].rid)
                for e in tl if "args" in e and "rid" in e.get("args", {}))
     # boundary-sampled export happened, orders of magnitude below
     # per-token rate; and no program was compiled by the pipeline
@@ -444,8 +448,8 @@ def test_hedged_failover_single_correlated_timeline(engine, oracle):
     arids = {e["args"]["arid"] for e in timeline
              if "arid" in e.get("args", {})}
     assert len(arids) == 2
-    assert all(e["args"]["rid"] == probe.rid for e in timeline
-               if "rid" in e.get("args", {}))
+    assert all(e["args"]["rid"] == corr_rid(probe.rid)
+               for e in timeline if "rid" in e.get("args", {}))
     # the terminal event names the WINNER and the attempt count
     done = next(e for e in timeline if e["name"] == "request_done")
     assert done["args"]["kind"] == "finished"

@@ -84,3 +84,30 @@ def test_emit_sbatch_cli(capsys):
     out = capsys.readouterr().out
     assert "#SBATCH --nodes=3" in out
     assert "train.py --lr 0.1" in out
+
+
+def test_sbatch_requeue_and_elastic_restart_flags():
+    """Requeue-on-failure + bounded in-allocation restarts (ISSUE 12):
+    the recovery layers the reference's advertised-but-never-shipped
+    SLURM launch needed."""
+    plain = slurm.sbatch_script(["t.py"])
+    assert "--requeue" not in plain and "for attempt" not in plain
+
+    text = slurm.sbatch_script(["t.py"], requeue=True, max_restarts=2)
+    assert "#SBATCH --requeue" in text
+    assert "#SBATCH --open-mode=append" in text
+    # the restart loop wraps the SAME srun line, is bounded, and a
+    # permanently failing job still exits non-zero
+    assert "for attempt in $(seq 0 2); do" in text
+    assert "srun python -m dtdl_tpu.launch.slurm -- t.py && exit 0" \
+        in text
+    assert text.rstrip().endswith("exit 1")
+
+
+def test_emit_sbatch_cli_requeue_flags(capsys):
+    rc = slurm.main(["--emit-sbatch", "--requeue", "--max-restarts",
+                     "3", "--", "train.py"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "#SBATCH --requeue" in out
+    assert "$(seq 0 3)" in out
