@@ -890,10 +890,54 @@ def bench_fleet(n_requests: int = 24, new_tokens: int = 24) -> dict:
     return row
 
 
+def bench_store_rpc(n_ops: int = 300) -> dict:
+    """Store RPC microbench (ISSUE 13): per-verb latency of the
+    control-plane store, local (``HostKVStore`` — a lock and a dict)
+    vs TCP (``TCPStoreClient`` against a localhost
+    ``TCPStoreServer`` — framing + a socket round trip).  The gap IS
+    the price of a real multi-process control plane, and the number
+    SCALING.md's heartbeat-period arithmetic divides by: a verb's p99
+    must sit far under ``heartbeat_s`` or the liveness layer's beat
+    thread falls behind its own lease."""
+    from dtdl_tpu.obs.hist import LogHistogram
+    from dtdl_tpu.parallel.kvstore import HostKVStore
+    from dtdl_tpu.parallel.tcpstore import TCPStoreClient, TCPStoreServer
+
+    def drive(store):
+        hists = {v: LogHistogram() for v in ("set", "get", "add")}
+        ops = {"set": lambda i: store.set(f"k{i % 32}", i),
+               "get": lambda i: store.get(f"k{i % 32}", None),
+               "add": lambda i: store.add("ctr")}
+        for verb, h in hists.items():
+            for i in range(n_ops):
+                t0 = time.perf_counter()
+                ops[verb](i)
+                h.add(time.perf_counter() - t0)
+        return {verb: h.summary(unit=1e6, digits=2)   # microseconds
+                for verb, h in hists.items()}
+
+    row = {"model": "store_rpc", "n_ops": n_ops}
+    row["local"] = drive(HostKVStore())
+    server = TCPStoreServer().start()
+    try:
+        row["tcp"] = drive(TCPStoreClient(server.addr))
+    finally:
+        server.stop()
+    return row
+
+
 def bench_elastic(n_workers: int = 4, steps: int = 12,
-                  overhead_steps: int = 24, reps: int = 3) -> dict:
+                  overhead_steps: int = 24, reps: int = 3,
+                  backend: str = "host") -> dict:
     """Elastic-training row (ISSUE 12): the kill-one-of-N drill's MTTR
     decomposition plus the liveness-layer overhead receipt.
+
+    ``backend`` selects the control-plane store (ISSUE 13): ``host``
+    is the PR 12 in-process ``HostKVStore``; ``tcp`` runs the SAME
+    drill through a localhost ``TCPStoreServer`` + per-world
+    ``TCPStoreClient`` — the elastic_tcp row's MTTR sits beside the
+    in-process one, so the cost of real sockets on the recovery path
+    is a printed number, not a guess.
 
     Drill: ``n_workers`` thread-hosted ElasticWorkers train a tiny MLP
     through the host control-plane store; ``peer_site`` kills one
@@ -915,10 +959,22 @@ def bench_elastic(n_workers: int = 4, steps: int = 12,
     from dtdl_tpu.data.sharding import GlobalBatchSampler
     from dtdl_tpu.models import MLP
     from dtdl_tpu.parallel.kvstore import HostKVStore, RetryingStore
+    from dtdl_tpu.parallel.tcpstore import TCPStoreClient, TCPStoreServer
     from dtdl_tpu.resil import (ElasticConfig, ElasticWorker, FaultPlan,
                                 effective_sample_log, peer_site,
                                 run_workers)
     from dtdl_tpu.train import init_state
+
+    if backend not in ("host", "tcp"):
+        raise ValueError(f"unknown store backend {backend!r}")
+    servers = []
+
+    def mk_store():
+        if backend == "host":
+            return HostKVStore()
+        srv = TCPStoreServer().start()
+        servers.append(srv)
+        return TCPStoreClient(srv.addr)
 
     n_ex, dim, gbatch = 96, 16, 12
     rng = np.random.default_rng(0)
@@ -952,14 +1008,15 @@ def bench_elastic(n_workers: int = 4, steps: int = 12,
             sampler=sampler, total_steps=n_steps, cfg=cfg,
             ckpt_dir=ckpt_dir, audit_samples=True) for r in ranks]
 
-    row = {"model": "elastic", "n_workers": n_workers, "steps": steps}
+    row = {"model": "elastic" if backend == "host" else "elastic_tcp",
+           "n_workers": n_workers, "steps": steps, "backend": backend}
 
     # ---- liveness-layer overhead: heartbeats on vs off ----------------
     def world_wall(heartbeat_s):
         cfg = ElasticConfig(heartbeat_s=heartbeat_s, watchdog_s=0.5,
                             step_timeout_s=30.0, join_grace_s=0.1,
                             snapshot_every=10 ** 9)
-        ws = mk_world(HostKVStore(), list(range(2)), overhead_steps, cfg)
+        ws = mk_world(mk_store(), list(range(2)), overhead_steps, cfg)
         t0 = time.perf_counter()
         run_workers(ws, timeout_s=300)
         assert all(w.done for w in ws)
@@ -981,7 +1038,7 @@ def bench_elastic(n_workers: int = 4, steps: int = 12,
     victim_rank, kill_at = n_workers - 2, steps // 2
     plan = FaultPlan().at(peer_site(victim_rank, "step"), kill_at,
                           "crash")
-    store = HostKVStore()
+    store = mk_store()
     ckpt_dir = tempfile.mkdtemp(prefix="bench_elastic_")
     with plan:
         ws = mk_world(store, list(range(n_workers)), steps, cfg,
@@ -1030,6 +1087,8 @@ def bench_elastic(n_workers: int = 4, steps: int = 12,
         "samples_lost": lost,
         "samples_double_counted": dups,
     }
+    for srv in servers:
+        srv.stop()
     return row
 
 
@@ -1401,6 +1460,14 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-elastic", action="store_true",
                    help="skip the elastic-training row (kill-one-of-N "
                         "MTTR drill + liveness-layer overhead)")
+    p.add_argument("--skip-elastic-tcp", action="store_true",
+                   help="skip the TCP-backed elastic row (the same "
+                        "kill-one-of-N MTTR drill through a localhost "
+                        "TCPStoreServer instead of the in-process "
+                        "store)")
+    p.add_argument("--skip-store-rpc", action="store_true",
+                   help="skip the control-plane store RPC microbench "
+                        "(local vs TCP per-verb latency)")
     p.add_argument("--skip-obs-pipeline", action="store_true",
                    help="skip the serve observability-pipeline row "
                         "(correlated tracing + exporter + SLO eval on "
@@ -1578,6 +1645,31 @@ def main(argv=None) -> dict:
                            "error": f"{type(e).__name__}: {e}"[:200]}
         records.append(elastic_row)
         print("  " + json.dumps(elastic_row), file=sys.stderr, flush=True)
+
+    elastic_tcp_row = None
+    if not a.skip_elastic_tcp:
+        # the SAME drill through real sockets (ISSUE 13): TCP-backed
+        # MTTR beside the in-process row
+        try:
+            elastic_tcp_row = bench_elastic(backend="tcp")
+        except Exception as e:  # must never sink the bench
+            elastic_tcp_row = {"model": "elastic_tcp",
+                               "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(elastic_tcp_row)
+        print("  " + json.dumps(elastic_tcp_row), file=sys.stderr,
+              flush=True)
+
+    store_rpc_row = None
+    if not a.skip_store_rpc:
+        # store RPC microbench (ISSUE 13): local vs TCP verb latency
+        try:
+            store_rpc_row = bench_store_rpc()
+        except Exception as e:  # must never sink the bench
+            store_rpc_row = {"model": "store_rpc",
+                             "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(store_rpc_row)
+        print("  " + json.dumps(store_rpc_row), file=sys.stderr,
+              flush=True)
 
     ok = [r for r in records if "samples_per_sec" in r]
     # headline = the best-MFU row of the reference-parity model (pyramidnet),
@@ -1764,6 +1856,23 @@ def main(argv=None) -> dict:
         lv = elastic_row.get("liveness") or {}
         summary["elastic_liveness_overhead_frac"] = \
             lv.get("overhead_frac")
+
+    if elastic_tcp_row and "error" not in elastic_tcp_row:
+        dr = elastic_tcp_row.get("drill") or {}
+        summary["elastic_tcp_detect_s"] = dr.get("detect_s")
+        summary["elastic_tcp_reform_s"] = dr.get("reform_s")
+        summary["elastic_tcp_restore_s"] = dr.get("restore_s")
+        summary["elastic_tcp_mttr_s"] = dr.get("mttr_s")
+        summary["elastic_tcp_samples_lost"] = dr.get("samples_lost")
+        summary["elastic_tcp_samples_double_counted"] = \
+            dr.get("samples_double_counted")
+
+    if store_rpc_row and "error" not in store_rpc_row:
+        for backend in ("local", "tcp"):
+            verbs = store_rpc_row.get(backend) or {}
+            get = verbs.get("get") or {}
+            summary[f"store_rpc_{backend}_get_p50_us"] = get.get("p50")
+            summary[f"store_rpc_{backend}_get_p99_us"] = get.get("p99")
 
     full = dict(summary)
     full["records"] = records

@@ -27,7 +27,9 @@ import time
 def launch_local(script_args: list[str], nproc: int = 2, port: int = 12355,
                  env_extra: dict | None = None, timeout: float = 600.0,
                  devices_per_proc: int | None = None,
-                 max_restarts: int = 0) -> int:
+                 max_restarts: int = 0, store_port: int | None = None,
+                 serve_store: bool = False,
+                 store_wal_dir: str | None = None) -> int:
     """Spawn ``nproc`` processes of a script; non-zero if any rank failed.
 
     ``max_restarts`` adds elastic recovery beyond the reference (whose jobs
@@ -45,26 +47,62 @@ def launch_local(script_args: list[str], nproc: int = 2, port: int = 12355,
     process dies — non-zero exit *or* a signal — the rest are terminated and
     the dying rank's code is returned: fail fast instead of the reference's
     silent hang.
+
+    The elastic control-plane store (ISSUE 13): ``serve_store=True``
+    hosts the :class:`~dtdl_tpu.parallel.tcpstore.TCPStoreServer` *in
+    the launcher process* (the coordinator host, which outlives any
+    worker; optional WAL dir for crash recovery — the server spans
+    restart attempts exactly like a real coordinator spans a worker
+    relaunch) and threads its address to every child as
+    ``DTDL_STORE_ADDR`` (``127.0.0.1:{store_port}``, defaulting to the
+    coordinator port + 1), so worker scripts reach it with
+    ``dtdl_tpu.parallel.tcpstore.connect()`` and no extra flags.  An
+    explicit ``store_port`` exports the address without serving (the
+    operator runs the server); otherwise the variable is only what the
+    children inherit from the environment — an address is never
+    advertised unless something actually listens there.
     """
-    attempt = 0
-    while True:
-        rc = _launch_once(script_args, nproc, port, env_extra, timeout,
-                          devices_per_proc)
-        if rc == 0 or attempt >= max_restarts:
-            return rc
-        attempt += 1
-        print(f"[launcher] attempt {attempt}/{max_restarts}: relaunching "
-              f"all {nproc} ranks (resume from latest checkpoint)",
-              flush=True)
+    # DTDL_STORE_ADDR is exported to children ONLY when a store
+    # actually exists: serve_store / an explicit store_port (operator
+    # intent: "my server is there"), or an inherited env value (an
+    # external coordinator — flows through dict(os.environ) untouched).
+    # Advertising the derived default with nothing listening would
+    # turn the crisp "no store address" error into a slow
+    # retry-to-death against a dead port.
+    explicit = store_port is not None or serve_store
+    store_port = store_port if store_port is not None else port + 1
+    store_addr = f"127.0.0.1:{store_port}" if explicit else None
+    server = None
+    if serve_store:
+        from dtdl_tpu.parallel.tcpstore import TCPStoreServer
+        server = TCPStoreServer(port=store_port,
+                                wal_dir=store_wal_dir).start()
+    try:
+        attempt = 0
+        while True:
+            rc = _launch_once(script_args, nproc, port, env_extra,
+                              timeout, devices_per_proc, store_addr)
+            if rc == 0 or attempt >= max_restarts:
+                return rc
+            attempt += 1
+            print(f"[launcher] attempt {attempt}/{max_restarts}: "
+                  f"relaunching all {nproc} ranks (resume from latest "
+                  f"checkpoint)", flush=True)
+    finally:
+        if server is not None:
+            server.stop()
 
 
 def _launch_once(script_args: list[str], nproc: int, port: int,
                  env_extra: dict | None, timeout: float,
-                 devices_per_proc: int | None) -> int:
+                 devices_per_proc: int | None,
+                 store_addr: str | None = None) -> int:
     procs: list[subprocess.Popen] = []
     coordinator = f"127.0.0.1:{port}"
     for i in range(nproc):
         env = dict(os.environ)
+        if store_addr:
+            env["DTDL_STORE_ADDR"] = store_addr
         if env_extra:
             env.update(env_extra)
         if devices_per_proc is not None:
@@ -126,6 +164,7 @@ def _launch_once(script_args: list[str], nproc: int, port: int,
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     nproc, port, devices, restarts = 2, 12355, None, 0
+    store_port, serve_store, store_wal = None, False, None
     while argv and argv[0] != "--":
         if argv[0] == "--nproc":
             nproc = int(argv[1]); argv = argv[2:]
@@ -135,6 +174,12 @@ def main(argv=None) -> int:
             devices = int(argv[1]); argv = argv[2:]
         elif argv[0] == "--max-restarts":
             restarts = int(argv[1]); argv = argv[2:]
+        elif argv[0] == "--store-port":
+            store_port = int(argv[1]); argv = argv[2:]
+        elif argv[0] == "--serve-store":
+            serve_store = True; argv = argv[1:]
+        elif argv[0] == "--store-wal-dir":
+            store_wal = argv[1]; argv = argv[2:]
         else:
             raise SystemExit(f"unknown launcher flag {argv[0]} "
                              "(use: --nproc N --port P -- script.py ...)")
@@ -144,7 +189,9 @@ def main(argv=None) -> int:
         raise SystemExit("no script given; usage: "
                          "python -m dtdl_tpu.launch.local --nproc 2 -- script.py")
     return launch_local(argv, nproc=nproc, port=port,
-                        devices_per_proc=devices, max_restarts=restarts)
+                        devices_per_proc=devices, max_restarts=restarts,
+                        store_port=store_port, serve_store=serve_store,
+                        store_wal_dir=store_wal)
 
 
 if __name__ == "__main__":

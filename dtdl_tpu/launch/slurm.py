@@ -121,10 +121,35 @@ def maybe_slurm(environ=None) -> dict | None:
             "process_id": process_id}
 
 
+def store_port(environ=None) -> int:
+    """Stable per-job control-plane store port, in its OWN band above
+    the coordinator span (``_BASE_PORT + _PORT_SPAN + id % span``): a
+    ``+1`` offset would land exactly on the NEXT job id's coordinator
+    port, and sequentially-submitted jobs sharing a head node would
+    collide — the very thing :func:`job_port` exists to prevent."""
+    return job_port(environ) + _PORT_SPAN
+
+
+def store_addr_from_env(environ=None) -> str:
+    """The elastic control-plane store address under SLURM: the
+    coordinator host (first node of the allocation) at
+    :func:`store_port` — the same derivation the sbatch export below
+    does in shell, so a task inside the allocation and the generated
+    batch script can never disagree on where the store lives."""
+    environ = environ if environ is not None else os.environ
+    nodelist = (environ.get("SLURM_STEP_NODELIST")
+                or environ["SLURM_JOB_NODELIST"])
+    head = expand_nodelist(nodelist)[0]
+    return f"{head}:{store_port(environ)}"
+
+
 def sbatch_script(script_args: list[str], nodes: int = 2,
                   ntasks_per_node: int = 1, job_name: str = "dtdl_tpu",
                   time_limit: str = "01:00:00", partition: str = "",
-                  requeue: bool = False, max_restarts: int = 0) -> str:
+                  requeue: bool = False, max_restarts: int = 0,
+                  store: bool = False,
+                  store_wal_dir: str = "$SLURM_SUBMIT_DIR/store_wal"
+                  ) -> str:
     """A ready-to-submit sbatch file: one task per host (the JAX
     multi-controller model — each process drives all local TPU chips,
     unlike the reference's one-process-per-GPU spawn).
@@ -142,6 +167,18 @@ def sbatch_script(script_args: list[str], nodes: int = 2,
       going back through the scheduler queue (the launch.local
       ``max_restarts`` model, minutes cheaper than a requeue), bounded
       so a deterministic crash still fails the job loudly.
+
+    ``store=True`` (ISSUE 13) adds the multi-process control plane:
+    the batch step (which runs on the allocation's first node — the
+    coordinator host) exports ``DTDL_STORE_ADDR`` (head node, the
+    per-job store band — the same arithmetic
+    :func:`store_addr_from_env` does) and
+    backgrounds a :mod:`dtdl_tpu.parallel.tcpstore` coordinator with a
+    WAL in ``store_wal_dir``.  The server lives OUTSIDE the srun step,
+    so it spans every in-allocation restart — and because the WAL
+    survives even a requeue, a re-queued job's store recovers its
+    generation and commit markers instead of coming back amnesiac
+    (which clients would refuse by epoch, by name).
     """
     payload = " ".join(shlex.quote(a) for a in script_args)
     lines = [
@@ -165,6 +202,32 @@ def sbatch_script(script_args: list[str], nodes: int = 2,
         "",
         "# every task self-discovers coordinator/rank from SLURM_* env",
     ]
+    if store:
+        lines += [
+            "# control-plane store: coordinator host (first node) at",
+            "# the store port band; WAL-backed so a restart (or a",
+            "# whole-job requeue) recovers keys/generation/leases",
+            "head=$(scontrol show hostnames \"$SLURM_JOB_NODELIST\""
+            " | head -n1)",
+            f"store_port=$(({_BASE_PORT + _PORT_SPAN} + "
+            f"SLURM_JOB_ID % {_PORT_SPAN}))",
+            "export DTDL_STORE_ADDR=\"${head}:${store_port}\"",
+            f"mkdir -p {store_wal_dir}",
+            "python -m dtdl_tpu.parallel.tcpstore --host 0.0.0.0 "
+            "--port \"${store_port}\" "
+            f"--wal-dir {store_wal_dir} > store.log 2>&1 &",
+            "store_pid=$!",
+            "trap 'kill ${store_pid} 2>/dev/null' EXIT",
+            "# wait (bounded) for the coordinator's ready line: its",
+            "# cold start (interpreter + imports on a shared FS) must",
+            "# not race the workers' connect budgets",
+            "for _ in $(seq 1 120); do",
+            "    grep -q 'STORE ready' store.log 2>/dev/null && break",
+            "    kill -0 ${store_pid} 2>/dev/null || "
+            "{ cat store.log >&2; exit 1; }",
+            "    sleep 1",
+            "done",
+        ]
     if max_restarts > 0:
         lines += [
             f"# elastic restart: up to {max_restarts} in-allocation",
@@ -192,7 +255,7 @@ def main(argv=None) -> int:
     if argv[:1] == ["--emit-sbatch"]:
         argv = argv[1:]
         nodes, per_node, partition = 2, 1, ""
-        requeue, max_restarts = False, 0
+        requeue, max_restarts, store = False, 0, False
         while argv and argv[0] != "--":
             if argv[0] == "--nodes":
                 nodes = int(argv[1]); argv = argv[2:]
@@ -204,6 +267,8 @@ def main(argv=None) -> int:
                 requeue = True; argv = argv[1:]
             elif argv[0] == "--max-restarts":
                 max_restarts = int(argv[1]); argv = argv[2:]
+            elif argv[0] == "--store":
+                store = True; argv = argv[1:]
             else:
                 raise SystemExit(f"unknown flag {argv[0]}")
         script = argv[1:] if argv[:1] == ["--"] else argv
@@ -211,7 +276,7 @@ def main(argv=None) -> int:
             raise SystemExit("no script given after --")
         print(sbatch_script(script, nodes=nodes, ntasks_per_node=per_node,
                             partition=partition, requeue=requeue,
-                            max_restarts=max_restarts))
+                            max_restarts=max_restarts, store=store))
         return 0
 
     script = argv[1:] if argv[:1] == ["--"] else argv
@@ -220,6 +285,11 @@ def main(argv=None) -> int:
             "usage: srun python -m dtdl_tpu.launch.slurm -- script.py --flags\n"
             "   or: python -m dtdl_tpu.launch.slurm --emit-sbatch -- script.py")
     coordinator, num_processes, process_id = from_env()
+    # NOTE: the store address is NOT auto-exported here — only the
+    # sbatch `store=True` path exports DTDL_STORE_ADDR, because only
+    # it actually launches a server.  Scripts that run their own
+    # coordinator derive the canonical address via
+    # :func:`store_addr_from_env`.
     cmd = [sys.executable, *script,
            "--coordinator", coordinator,
            "--num-processes", str(num_processes),

@@ -120,6 +120,13 @@ EVENT_CATALOG = frozenset({
     # as a silent hang
     "elastic_peer_lost", "elastic_rendezvous", "elastic_restore",
     "elastic_snapshot", "elastic_stale_fenced", "elastic_step_timeout",
+    # TCP control-plane store (round 18): every socket-level recovery
+    # edge of the coordinator protocol — a reconnect after a dead
+    # socket, a torn reply frame detected by name, an amnesiac
+    # coordinator refused by epoch, and a WAL rehydration on the
+    # server side
+    "store_reconnect", "store_torn_frame", "store_epoch_refused",
+    "store_wal_recovered",
 })
 
 

@@ -182,6 +182,31 @@ class HostKVStore:
                       if k.startswith(prefix)]
         return None if not stamps else time.monotonic() - max(stamps)
 
+    # ---- state transfer (the WAL/snapshot hooks of the TCP server) ---
+
+    def snapshot_state(self) -> tuple[dict, int]:
+        """Consistent copy of (data, generation) — what a coordinator
+        snapshot must persist.  Stamps are deliberately NOT part of the
+        state: lease ages are judged on the live store's clock, and a
+        recovered store re-stamps everything at recovery time (see
+        :meth:`restore_state`)."""
+        with self._cond:
+            return dict(self._data), self._gen
+
+    def restore_state(self, data: dict, gen: int) -> None:
+        """Install recovered state.  Every key is re-stamped *now*: a
+        store cannot judge lease staleness across its own outage, so
+        recovery resets every age to zero — strictly conservative (no
+        peer is declared dead because the COORDINATOR was down); a peer
+        that really died during the outage stops beating and is
+        re-detected one watchdog period after recovery."""
+        with self._cond:
+            self._data = dict(data)
+            now = time.monotonic()
+            self._stamp = {k: now for k in self._data}
+            self._gen = int(gen)
+            self._cond.notify_all()
+
     # ---- generation fencing ------------------------------------------
 
     @property
@@ -219,6 +244,13 @@ def store_barrier(store, name: str, ranks, rank: int, gen: int = 0,
     :class:`StaleGenerationError` by name, and a dead peer surfaces as
     the same named :class:`~dtdl_tpu.runtime.bootstrap.
     BarrierTimeoutError` the device-plane barrier uses — never a hang.
+
+    The poll is **deadline-sliced**: each sleep is bounded by the
+    remaining budget, never a full fixed ``poll_s`` — a sub-watchdog
+    ``timeout_s`` must expire ON TIME, not overshoot by a poll period
+    (a barrier armed with a 50 ms budget inside a 200 ms watchdog that
+    silently waited 1 s would defeat the watchdog arithmetic the
+    elastic layer's SCALING.md failure model depends on).
     """
     store.check_generation(gen)
     store.set(f"bar/{gen}/{name}/{rank}", True)
@@ -229,11 +261,12 @@ def store_barrier(store, name: str, ranks, rank: int, gen: int = 0,
         if not missing:
             return
         store.check_generation(gen)
-        if time.monotonic() > deadline:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
             raise BarrierTimeoutError(
                 f"store barrier {name!r} (generation {gen}) timed out "
                 f"after {timeout_s}s waiting for rank(s) {missing}")
-        time.sleep(poll_s)
+        time.sleep(min(poll_s, remaining))
 
 
 class RetryingStore:

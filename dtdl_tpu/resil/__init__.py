@@ -36,7 +36,7 @@ from dtdl_tpu.resil.elastic import (  # noqa: F401
 )
 from dtdl_tpu.resil.faults import (  # noqa: F401
     Fault, FaultPlan, InjectedCrash, InjectedFault, LoaderFaults, fire,
-    peer_site, poison_batch, replica_site,
+    peer_site, poison_batch, replica_site, store_site,
 )
 from dtdl_tpu.resil.guard import (  # noqa: F401
     AnomalousStepError, GuardEscalationError, GuardRollback, StepGuard,

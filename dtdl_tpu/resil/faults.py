@@ -55,7 +55,8 @@ from typing import Iterable, Optional
 
 import numpy as np
 
-KINDS = ("raise", "crash", "sigterm", "stall", "nan", "blackhole")
+KINDS = ("raise", "crash", "sigterm", "sigkill", "stall", "nan",
+         "blackhole", "torn")
 
 # the serving-fleet injection surface (dtdl_tpu/serve/fleet.py): every
 # replica exposes three sites, so every transition of the router's
@@ -95,6 +96,42 @@ REPLICA_POINTS = ("engine", "loop", "probe")
 #               window closed: the formed world excludes it and it is
 #               refused by name).
 PEER_POINTS = ("step", "heartbeat", "join")
+
+
+# the control-plane store injection surface (dtdl_tpu/parallel/
+# tcpstore.py): the TCP client and server fire three sites so every
+# socket-level edge of the store protocol is deterministically
+# reachable —
+#   rpc     — fired by the CLIENT before each RPC send ("raise" at
+#             occurrence k == the connection dying under exactly the
+#             k-th RPC: the client's framing layer sees a dead socket,
+#             reconnects, and surfaces only TransientStoreError;
+#             "blackhole" == the network eats the request — nothing is
+#             sent and the client's IO deadline expires into the same
+#             transient path; "stall" with `seconds` == a slow link);
+#   connect — fired by the CLIENT on each (re)connect attempt ("raise"
+#             == connection refused: the coordinator is down or still
+#             restarting; the bounded jittered backoff rides it);
+#   reply   — fired by the SERVER before each reply frame ("torn" ==
+#             half the response frame is written and the connection
+#             killed, so the client's torn-frame detection fires BY
+#             NAME; "crash" == the coordinator process dies mid-reply
+#             — the whole server aborts, nothing else is sent, and a
+#             test restarts it from the WAL; "raise" == this one
+#             connection is dropped without a reply; "blackhole" ==
+#             the reply never comes and the client times out).
+STORE_POINTS = ("rpc", "connect", "reply")
+
+
+def store_site(point: str) -> str:
+    """Canonical fault-site name for the TCP control-plane store — one
+    of the three socket-level injection points above.  Central so
+    tests, the TCPStore client/server, and FaultPlan schedules can
+    never drift on spelling."""
+    if point not in STORE_POINTS:
+        raise ValueError(f"unknown store fault point {point!r} "
+                         f"(one of {STORE_POINTS})")
+    return f"store.{point}"
 
 
 def peer_site(rank: int, point: str) -> str:
@@ -193,11 +230,12 @@ class FaultPlan:
 
     def fire(self, site: str) -> Optional[Fault]:
         """Record one occurrence of ``site``; trigger any fault scheduled
-        for it.  Control-flow kinds (raise/crash/sigterm/stall) trigger
-        here; data kinds (``nan``, ``blackhole``) are returned for the
-        caller — e.g. :class:`LoaderFaults` poisons its payload on
-        ``nan``, a fleet Replica's probe reports no-answer on
-        ``blackhole``."""
+        for it.  Control-flow kinds (raise/crash/sigterm/sigkill/stall)
+        trigger here; data kinds (``nan``, ``blackhole``, ``torn``) are
+        returned for the caller — e.g. :class:`LoaderFaults` poisons its
+        payload on ``nan``, a fleet Replica's probe reports no-answer on
+        ``blackhole``, the TCP store server tears a reply frame on
+        ``torn``."""
         i = self._counts[site]
         self._counts[site] += 1
         for f in self.faults:
@@ -210,6 +248,12 @@ class FaultPlan:
                     raise err(f"injected {f.kind} at {site}#{i}")
                 if f.kind == "sigterm":
                     os.kill(os.getpid(), signal.SIGTERM)
+                elif f.kind == "sigkill":
+                    # real, uncatchable process death — the subprocess
+                    # elastic drills use this for a worker that
+                    # genuinely vanishes (no atexit, no flush, no
+                    # goodbye on its sockets)
+                    os.kill(os.getpid(), signal.SIGKILL)
                 elif f.kind == "stall":
                     time.sleep(f.seconds)
                 return f
@@ -238,15 +282,19 @@ class FaultPlan:
 _PLAN: Optional[FaultPlan] = None
 
 
-def fire(site: str) -> None:
+def fire(site: str) -> Optional[Fault]:
     """The product-code hook: a no-op unless a plan is installed.
 
     Sites live in checkpoint-critical windows (module docstring); the
     uninstalled cost is one global read and an ``is None`` check, so the
     hook stays in production builds — the harness tests the *same* code
-    that ships, not an instrumented twin."""
+    that ships, not an instrumented twin.  Data kinds (``nan`` /
+    ``blackhole`` / ``torn``) are returned to the caller, exactly like
+    :meth:`FaultPlan.fire` — the TCP store consults the returned fault
+    to decide whether to eat a request or tear a reply frame."""
     if _PLAN is not None:
-        _PLAN.fire(site)
+        return _PLAN.fire(site)
+    return None
 
 
 def poison_batch(batch: dict) -> dict:

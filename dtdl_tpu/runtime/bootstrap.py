@@ -42,7 +42,7 @@ def backoff_delay(attempt: int, backoff_s: float, max_backoff_s: float,
 def initialize(coordinator: str = "", num_processes: int = 1,
                process_id: int = 0, local_device_ids=None,
                retries: int = 0, backoff_s: float = 1.0,
-               max_backoff_s: float = 15.0) -> None:
+               max_backoff_s: float = 15.0, store_addr: str = "") -> None:
     """Join (or create) the multi-process cluster.
 
     No-op for single-process runs — a plain ``python script.py`` works with no
@@ -57,8 +57,18 @@ def initialize(coordinator: str = "", num_processes: int = 1,
     exponential backoff plus jitter — bounded, so a permanently absent
     coordinator still fails loudly with the original error instead of
     retrying forever.
+
+    ``store_addr`` (ISSUE 13) publishes the elastic control-plane
+    store's ``host:port`` as ``DTDL_STORE_ADDR`` for everything
+    downstream (``dtdl_tpu.parallel.tcpstore.connect()`` reads it) —
+    published even for single-process runs, because the control plane
+    outlives any one JAX world by design.  The launchers thread it
+    through automatically (launch/local env export, the sbatch
+    coordinator-host export).
     """
     global _initialized
+    if store_addr:
+        os.environ["DTDL_STORE_ADDR"] = store_addr
     if num_processes <= 1 and not coordinator:
         return
     if _initialized:

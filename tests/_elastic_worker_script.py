@@ -1,0 +1,164 @@
+"""Child process for the subprocess elastic drills (ISSUE 13).
+
+One REAL OS process per elastic worker: connects to the TCP
+control-plane store at ``--store-addr`` (or ``DTDL_STORE_ADDR``),
+rendezvouses, trains, and writes its result as JSON.  ``--die-at N``
+installs a ``peer_site(rank, 'step')`` **sigkill** fault — the process
+is killed by the kernel at the top of step N, with no atexit, no
+flush, no goodbye on its sockets: exactly a crashed host.
+
+The training problem is pure-host numpy (rank-ordered float64 sums —
+bitwise deterministic across processes with zero compile cost; the
+jax-compiled bitwise story is pinned in-process by tests/
+test_elastic.py).  The module is IMPORTABLE: the parent test imports
+the same problem definitions to run the fault-free shrunken oracle
+in-process, so "bitwise equal" compares one problem, two hosting
+models.
+
+Every applied step appends one flushed JSONL line of the consumed
+shard indices to ``samples_{rank}.jsonl`` — the SIGKILLed victim's
+pre-crash consumption survives its death, which is what makes the
+zero-lost/zero-dup audit possible across a real process kill.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from dtdl_tpu.data.sharding import GlobalBatchSampler  # noqa: E402
+from dtdl_tpu.resil import (ElasticConfig, ElasticWorker,  # noqa: E402
+                            FaultPlan, peer_site)
+
+# ---------------------------------------------------------------------------
+# the shared tiny problem (imported by the parent test for the oracle)
+# ---------------------------------------------------------------------------
+
+N, DIM, GLOBAL_BATCH, STEPS = 48, 8, 12, 8
+_RNG = np.random.default_rng(7)
+X = _RNG.normal(size=(N, DIM))
+Y = _RNG.normal(size=(N,))
+LR = 0.05
+
+
+def init_fn():
+    return {"w": np.zeros(DIM, np.float64)}
+
+
+def grad_fn(state, batch):
+    err = batch["x"] @ state["w"] - batch["y"]
+    return {"w": batch["x"].T @ err}
+
+
+def apply_fn(state, total, world_size):
+    return {"w": state["w"] - LR * total["w"] / world_size}
+
+
+def batch_fn(idx):
+    return {"x": X[idx], "y": Y[idx]}
+
+
+def mk_sampler():
+    return GlobalBatchSampler(N, GLOBAL_BATCH, seed=3)
+
+
+def mk_cfg():
+    # min_world=3 + a wide join grace: subprocess workers reach
+    # rendezvous staggered by their interpreter/import time, and a
+    # quick-off-the-blocks leader must not close bootstrap without
+    # them (the thread-hosted drills never see this — threads start
+    # microseconds apart; real processes are the point of this file)
+    return ElasticConfig(heartbeat_s=0.05, watchdog_s=0.6,
+                         step_timeout_s=20.0, join_grace_s=0.8,
+                         rendezvous_timeout_s=30.0, min_world=3,
+                         snapshot_every=2)
+
+
+class JournalingWorker(ElasticWorker):
+    """ElasticWorker that flushes each applied step's consumed shard
+    indices to a per-rank JSONL — durable against SIGKILL, unlike the
+    in-memory ``sample_log``."""
+
+    def __init__(self, *args, journal_path=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._journal = open(journal_path, "a")
+
+    def _mark(self, name, **info):
+        super()._mark(name, **info)
+        if name == "applied":
+            gen, step = info["generation"], info["step"]
+            idx = np.asarray(self.sample_log[(gen, step)])
+            self._journal.write(json.dumps(
+                {"gen": int(gen), "step": int(step),
+                 "idx": idx.tolist()}) + "\n")
+            self._journal.flush()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--store-addr",
+                   default=os.environ.get("DTDL_STORE_ADDR", ""))
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--steps", type=int, default=STEPS)
+    p.add_argument("--die-at", type=int, default=-1)
+    a = p.parse_args(argv)
+
+    # import here so a bare `import _elastic_worker_script` from the
+    # parent test never touches the network layer
+    from dtdl_tpu.parallel.tcpstore import connect
+
+    if a.die_at >= 0:
+        FaultPlan().at(peer_site(a.rank, "step"), a.die_at,
+                       "sigkill").install()
+
+    # generous transport budgets: a coordinator restart in the slow
+    # drill costs a fresh interpreter + imports (~2-4s), and the
+    # un-retried generation reads tolerate exactly
+    # rpc_retries x reconnect-budget of downtime
+    store = connect(a.store_addr, retries=10, seed=a.rank,
+                    connect_timeout_s=2.0, io_timeout_s=3.0,
+                    reconnect_attempts=10, backoff_s=0.01,
+                    max_backoff_s=0.3, wait_slice_s=0.1, rpc_retries=4)
+    w = JournalingWorker(
+        store, a.rank, init_fn=init_fn, grad_fn=grad_fn,
+        apply_fn=apply_fn, batch_fn=batch_fn, sampler=mk_sampler(),
+        total_steps=a.steps, cfg=mk_cfg(), ckpt_dir=a.ckpt_dir,
+        audit_samples=True,
+        journal_path=os.path.join(a.out_dir,
+                                  f"samples_{a.rank}.jsonl"))
+    w.run()
+
+    restores = [info for n, _, info in w.events if n == "restore"]
+    lost = [info for n, _, info in w.events if n == "peer_lost"]
+    result = {
+        "rank": a.rank,
+        "done": w.done,
+        "fenced": w.fenced,
+        "error": repr(w.error) if w.error is not None else None,
+        "generation": w.world.generation if w.world else None,
+        "ranks": list(w.world.ranks) if w.world else None,
+        "step": w.step,
+        "restored_step": restores[0]["step"] if restores else None,
+        "lost": sorted(int(r) for info in lost
+                       for r in info.get("lost", ())),
+        "params_w": np.asarray(w.state["w"]).tolist()
+        if w.state is not None else None,
+        "reconnects":
+            store.store.metrics.summary().get("store_reconnects", 0),
+    }
+    path = os.path.join(a.out_dir, f"result_{a.rank}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(result, f)
+    os.replace(path + ".tmp", path)
+    return 0 if (w.done or w.fenced) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

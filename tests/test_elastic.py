@@ -25,7 +25,6 @@ The contracts:
 
 import functools
 import os
-import threading
 import time
 
 import jax
@@ -41,12 +40,10 @@ from dtdl_tpu.obs import (MetricsExporter, Observer, SLOEvaluator,
 from dtdl_tpu.obs.goodput import GoodputMeter
 from dtdl_tpu.parallel.kvstore import HostKVStore, RetryingStore
 from dtdl_tpu.resil import (ElasticConfig, ElasticWorker, FaultPlan,
-                            PeerLostError, RendezvousError,
-                            StaleGenerationError, StepGuard, StepWatchdog,
-                            World, dead_peers, effective_sample_log,
-                            exchange_grads, peer_site, rendezvous,
+                            PeerLostError, StaleGenerationError,
+                            StepGuard, StepWatchdog,
+                            effective_sample_log, peer_site,
                             run_workers)
-from dtdl_tpu.resil.elastic import HeartbeatLease
 from dtdl_tpu.runtime.mesh import build_mesh, shrink_mesh
 from dtdl_tpu.train import init_state
 
@@ -181,17 +178,9 @@ def test_peer_site_spelling():
         peer_site(0, "crash")
 
 
-def test_heartbeat_lease_and_dead_peers():
-    store = HostKVStore()
-    lease = HeartbeatLease(store, 0, heartbeat_s=0.02).start()
-    try:
-        assert dead_peers(store, [0], watchdog_s=0.2) == ()
-        # a rank that never beat is dead from the start
-        assert dead_peers(store, [0, 7], watchdog_s=0.2) == (7,)
-    finally:
-        lease.stop()
-    time.sleep(0.25)
-    assert dead_peers(store, [0], watchdog_s=0.2) == (0,)
+# NOTE: the lease/dead_peers, rendezvous-formation, and exchange unit
+# tests moved to tests/test_store_contract.py (ISSUE 13), where they
+# run over BOTH store backends — HostKVStore and the TCP client/server.
 
 
 def test_step_watchdog_names_the_hang():
@@ -202,38 +191,6 @@ def test_step_watchdog_names_the_hang():
     with pytest.raises(PeerLostError, match="drain did not settle"):
         wd.run(time.sleep, 0.6)
     assert wd.n_timeouts == 1
-
-
-def test_exchange_deadline_names_the_missing_peer():
-    """Wedged-peer path: lease checks off, the other rank never posts —
-    the step aborts at the deadline naming exactly the missing rank."""
-    store = HostKVStore()
-    world = World(0, (0, 1), 0)
-    cfg = mk_cfg(heartbeat_s=0, step_timeout_s=0.2, poll_s=0.02)
-    grads = {"w": np.ones(2, np.float32)}
-    with pytest.raises(PeerLostError) as ei:
-        exchange_grads(store, world, 0, grads, cfg)
-    assert ei.value.lost == (1,)
-    assert "deadline" in str(ei.value)
-
-
-def test_exchange_sums_in_rank_order():
-    store = HostKVStore()
-    cfg = mk_cfg(heartbeat_s=0)
-    outs = {}
-
-    def member(rank):
-        w = World(0, (0, 1, 2), rank)
-        outs[rank] = exchange_grads(
-            store, w, 0, {"g": np.full(2, float(rank + 1), np.float32)},
-            cfg)
-
-    ts = [threading.Thread(target=member, args=(r,)) for r in range(3)]
-    [t.start() for t in ts]
-    [t.join(10) for t in ts]
-    for r in range(3):
-        np.testing.assert_array_equal(outs[r]["g"],
-                                      np.full(2, 6.0, np.float32))
 
 
 def test_trainer_drain_rides_the_watchdog(tmp_path):
@@ -250,39 +207,6 @@ def test_trainer_drain_rides_the_watchdog(tmp_path):
     # and a healthy drain passes through untouched
     tr.metrics_queue.drain = lambda: []
     tr._drain_metrics()
-
-
-# ---------------------------------------------------------------------------
-# rendezvous: formation, min_world, bootstrap fencing
-# ---------------------------------------------------------------------------
-
-def test_rendezvous_forms_world_and_fences_late_bootstrap_joiner():
-    store = HostKVStore()
-    cfg = mk_cfg(join_grace_s=0.1, rendezvous_timeout_s=5.0)
-    got = {}
-
-    def join(rank):
-        got[rank] = rendezvous(store, rank, cfg)
-
-    ts = [threading.Thread(target=join, args=(r,)) for r in (0, 1)]
-    [t.start() for t in ts]
-    [t.join(10) for t in ts]
-    assert got[0].ranks == got[1].ranks == (0, 1)
-    assert got[0].generation == 0
-    assert got[0].is_leader and not got[1].is_leader
-    assert (got[0].index, got[1].index) == (0, 1)
-    # a worker arriving after bootstrap closed is refused BY NAME — it
-    # cannot silently grow (or hang) the formed world
-    with pytest.raises(StaleGenerationError, match="fenced out"):
-        rendezvous(store, 2, cfg)
-
-
-def test_rendezvous_below_min_world_fails_by_name():
-    store = HostKVStore()
-    cfg = mk_cfg(min_world=2, join_grace_s=0.05,
-                 rendezvous_timeout_s=0.4)
-    with pytest.raises(RendezvousError, match="min_world"):
-        rendezvous(store, 0, cfg)
 
 
 # ---------------------------------------------------------------------------

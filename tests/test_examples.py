@@ -254,6 +254,7 @@ _HELP_SCRIPTS = [
     "train_mnist_multi.py", "mxnet_kvstore.py", "caffe_train.py",
     "tf_estimator.py", "train_lm.py", "train_lm_4d.py",
     "train_lm_gspmd.py", "imagenet_resnet50.py", "serve_fleet.py",
+    "elastic_train.py",
 ]
 
 
@@ -327,6 +328,23 @@ def test_serve_lm_example():
         "--max-new-tokens", "6", "--harvest-lag", "2")
     assert re.search(r"served 5 requests", out), out
     assert "'decode': 1" in out, out
+
+
+@pytest.mark.slow
+@pytest.mark.elastic
+def test_elastic_train_example_demo(tmp_path):
+    """Elastic example end-to-end in --demo mode: a TCP coordinator, a
+    crash-injected worker, survivors re-form and finish with identical
+    param digests (compile-heavy -> slow; the fast TCP-store coverage
+    lives in tests/test_tcpstore.py and tests/test_store_contract.py)."""
+    out = run_example(
+        "elastic_train.py", "--demo", "--steps", "6", "--workers", "3",
+        "--ckpt-dir", str(tmp_path))
+    assert "coordinator up at" in out, out
+    assert re.search(r"rank 2 crashed at step 3; survivors detected",
+                     out), out
+    digests = re.findall(r"params_digest=([\d.]+)", out)
+    assert len(digests) == 2 and digests[0] == digests[1], out
 
 
 @pytest.mark.slow

@@ -5,9 +5,6 @@ identical to pmean DDP (the store is sum+rescale over the same mesh axis),
 and therefore to large-batch single-device training for BN-free models.
 """
 
-import threading
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -17,14 +14,8 @@ from jax.sharding import PartitionSpec as P
 
 from dtdl_tpu.models import MLP
 from dtdl_tpu.parallel import DataParallel, SingleDevice
-from dtdl_tpu.parallel.kvstore import (HostKVStore, KVStore,
-                                       KVStoreStrategy, RetryingStore,
-                                       StaleGenerationError,
-                                       StoreRetriesExhaustedError,
-                                       StoreTimeoutError,
-                                       TransientStoreError, create,
-                                       kvstore_strategy, store_barrier)
-from dtdl_tpu.runtime.bootstrap import BarrierTimeoutError
+from dtdl_tpu.parallel.kvstore import (KVStore, KVStoreStrategy, create,
+                                       kvstore_strategy)
 from dtdl_tpu.train import init_state, make_train_step
 
 
@@ -126,131 +117,11 @@ def test_host_init_roundtrip():
     np.testing.assert_allclose(np.asarray(out["a"]), np.ones((2,)))
 
 
-# ---------------------------------------------------------------------------
-# host-side control-plane store (ISSUE 12): verbs, leases, fencing,
-# bounded retries
-# ---------------------------------------------------------------------------
-
-
-class FlakyStore:
-    """Seeded transient-failure wrapper: each op fails with
-    ``TransientStoreError`` with probability ``rate`` (deterministic
-    per seed) — the harness for the RetryingStore contract."""
-
-    def __init__(self, store, rate=0.5, seed=0):
-        self.store = store
-        self.rate = rate
-        self._rng = np.random.default_rng(seed)
-        self.failures = 0
-
-    def __getattr__(self, name):
-        inner = getattr(self.store, name)
-        if not callable(inner):
-            return inner
-        def wrapped(*a, **kw):
-            if self._rng.random() < self.rate:
-                self.failures += 1
-                raise TransientStoreError(f"injected blip in {name}")
-            return inner(*a, **kw)
-        return wrapped
-
-    @property
-    def generation(self):
-        return self.store.generation
-
-
-def test_host_store_verbs_and_lease_ages():
-    s = HostKVStore()
-    s.set("a", {"x": 1})
-    assert s.get("a") == {"x": 1}
-    assert s.get("missing", None) is None
-    with pytest.raises(KeyError):
-        s.get("missing")
-    assert s.add("ctr") == 1 and s.add("ctr", 2) == 3
-    s.delete("a")
-    assert s.get("a", None) is None
-    s.set("p/1", 1)
-    s.set("p/2", 2)
-    assert s.keys("p/") == ["p/1", "p/2"]
-    # store-side stamps: ages are judged on ONE clock
-    assert s.age("nope") is None and s.newest_age("q/") is None
-    assert 0 <= s.age("p/2") < 1.0
-    assert 0 <= s.newest_age("p/") <= s.age("p/1")
-
-
-def test_host_store_wait_blocks_and_times_out_by_name():
-    s = HostKVStore()
-    with pytest.raises(StoreTimeoutError, match="did not appear"):
-        s.wait("k", timeout_s=0.05)
-    threading.Timer(0.05, lambda: s.set("k", 7)).start()
-    assert s.wait("k", timeout_s=2.0) == 7
-
-
-def test_generation_cas_coalesces_and_fences():
-    s = HostKVStore()
-    assert s.generation == 0
-    # N survivors proposing concurrently land on ONE new epoch
-    assert s.bump_generation(0) == 1
-    assert s.bump_generation(0) == 1       # stale proposal: no-op
-    s.check_generation(1)
-    with pytest.raises(StaleGenerationError, match="generation 0 is "
-                                                   "stale"):
-        s.check_generation(0)
-
-
-def test_store_barrier_fences_stale_epoch_and_names_dead_peers():
-    s = HostKVStore()
-    # a stale-epoch ARRIVAL is rejected by name (never corrupts the
-    # current world's barrier)
-    s.bump_generation(0)
-    with pytest.raises(StaleGenerationError):
-        store_barrier(s, "sync", ranks=(0, 1), rank=0, gen=0)
-    # happy path at the current epoch
-    done = []
-
-    def arrive(r):
-        store_barrier(s, "sync", ranks=(0, 1), rank=r, gen=1,
-                      timeout_s=5.0)
-        done.append(r)
-
-    ts = [threading.Thread(target=arrive, args=(r,)) for r in (0, 1)]
-    [t.start() for t in ts]
-    [t.join(10) for t in ts]
-    assert sorted(done) == [0, 1]
-    # a dead peer surfaces as the named barrier timeout, not a hang
-    with pytest.raises(BarrierTimeoutError, match=r"rank\(s\) \[3\]"):
-        store_barrier(s, "sync2", ranks=(0, 3), rank=0, gen=1,
-                      timeout_s=0.1)
-    # an epoch bumped MID-WAIT fences the waiter out by name
-    t = threading.Timer(0.05, lambda: s.bump_generation(1))
-    t.start()
-    with pytest.raises(StaleGenerationError):
-        store_barrier(s, "sync3", ranks=(0, 9), rank=0, gen=1,
-                      timeout_s=5.0)
-
-
-def test_retrying_store_bounded_retries_succeed_then_exhaust():
-    # rate 0.5, seed 0: transient blips succeed within the budget
-    flaky = FlakyStore(HostKVStore(), rate=0.5, seed=0)
-    rs = RetryingStore(flaky, retries=5, backoff_s=0.001, seed=1)
-    for i in range(20):
-        rs.set(f"k{i}", i)
-        assert rs.get(f"k{i}") == i
-    assert rs.add("ctr") == 1
-    assert flaky.failures > 0            # the schedule really injected
-    # a permanently down store exhausts the bounded budget BY NAME,
-    # chaining the last transient error
-    dead = FlakyStore(HostKVStore(), rate=1.0, seed=2)
-    rs2 = RetryingStore(dead, retries=3, backoff_s=0.001, seed=1)
-    with pytest.raises(StoreRetriesExhaustedError,
-                       match="after 4 attempts") as ei:
-        rs2.get("k", None)
-    assert isinstance(ei.value.__cause__, TransientStoreError)
-    assert dead.failures == 4
-    # verdicts are never retried: fencing passes straight through
-    clean = RetryingStore(HostKVStore(), retries=3, backoff_s=0.001)
-    with pytest.raises(StaleGenerationError):
-        clean.check_generation(5)
+# NOTE: the host-side control-plane store tests (five verbs, lease
+# ages, generation CAS, fenced barrier, RetryingStore budgets) moved to
+# tests/test_store_contract.py in ISSUE 13, where they run over BOTH
+# backends — HostKVStore and the TCP client/server — through one shared
+# fixture.  This file keeps the jit-side (data-plane) KVStore tests.
 
 
 def test_width1_store_applies_rescale_and_average(devices):

@@ -119,6 +119,68 @@ def test_local_launcher_restart_budget_exhausted(tmp_path):
     assert rc == 5
 
 
+def test_local_launcher_threads_store_addr(capfd, monkeypatch):
+    """ISSUE 13 address threading is honest: an explicit store_port
+    exports DTDL_STORE_ADDR to every child; with no store configured
+    the children see whatever the environment inherits (an external
+    coordinator) or NOTHING — never an address nothing listens on."""
+    from dtdl_tpu.launch.local import launch_local
+    prog = ("import os; "
+            "print('ADDR=' + os.environ.get('DTDL_STORE_ADDR', 'unset'))")
+    monkeypatch.delenv("DTDL_STORE_ADDR", raising=False)
+    rc = launch_local(["-c", prog], nproc=2, port=12421,
+                      store_port=12422, timeout=60)
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert out.count("ADDR=127.0.0.1:12422") == 2
+    # no store configured: nothing is advertised...
+    rc = launch_local(["-c", prog], nproc=1, port=12423, timeout=60)
+    out = capfd.readouterr().out
+    assert rc == 0 and "ADDR=unset" in out
+    # ...and an inherited external coordinator flows through untouched
+    monkeypatch.setenv("DTDL_STORE_ADDR", "coordhost:12801")
+    rc = launch_local(["-c", prog], nproc=1, port=12424, timeout=60)
+    out = capfd.readouterr().out
+    assert rc == 0 and "ADDR=coordhost:12801" in out
+
+
+def test_local_launcher_serves_store_for_children(capfd):
+    """serve_store=True hosts the TCP coordinator in the launcher
+    process; two child PROCESSES coordinate through it (an add each,
+    then a blocking wait on the key the second arrival sets)."""
+    from dtdl_tpu.launch.local import launch_local
+    # membership via per-process SET keys, not add(): the overwrite
+    # verbs are exactly-once under the retry facade (see connect())
+    prog = (
+        "import os, time\n"
+        "from dtdl_tpu.parallel.tcpstore import connect\n"
+        "rs = connect(retries=5)\n"
+        "rs.set(f'join/{os.getpid()}', True)\n"
+        "deadline = time.time() + 60\n"
+        "while len(rs.keys('join/')) < 2:\n"
+        "    assert time.time() < deadline\n"
+        "    time.sleep(0.01)\n"
+        "rs.set('both', True)\n"
+        "rs.wait('both', timeout_s=60)\n"
+        "print('STORE-OK')\n"
+    )
+    rc = launch_local(["-c", prog], nproc=2, port=12425,
+                      serve_store=True, timeout=120)
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert out.count("STORE-OK") == 2
+
+
+def test_initialize_publishes_store_addr(monkeypatch):
+    """runtime.initialize(store_addr=...) publishes DTDL_STORE_ADDR
+    even for a single-process run — the control plane outlives any one
+    JAX world."""
+    from dtdl_tpu.runtime import bootstrap
+    monkeypatch.setenv("DTDL_STORE_ADDR", "stale:1")
+    bootstrap.initialize(store_addr="127.0.0.1:9999")
+    assert os.environ["DTDL_STORE_ADDR"] == "127.0.0.1:9999"
+
+
 def test_tpu_vm_run_elastic_restart(tmp_path, capsys):
     """tpu_vm.run with max_restarts relaunches the slice after a failure."""
     from dtdl_tpu.launch.tpu_vm import run

@@ -111,3 +111,49 @@ def test_emit_sbatch_cli_requeue_flags(capsys):
     out = capsys.readouterr().out
     assert "#SBATCH --requeue" in out
     assert "$(seq 0 3)" in out
+
+
+def test_store_addr_from_env_matches_sbatch_arithmetic():
+    """Python and the generated shell must agree on where the store
+    lives: coordinator host, store band (a +1 offset would collide
+    with the NEXT job id's coordinator port on a shared head node)."""
+    env = fake_env(job="111")
+    host, port = slurm.store_addr_from_env(env).rsplit(":", 1)
+    assert host == "tpu1"
+    assert int(port) == slurm.store_port(env)
+    # the store band and the coordinator band are disjoint: NO job's
+    # store port can equal ANY job's coordinator port
+    assert slurm.store_port(env) >= slurm._BASE_PORT + slurm._PORT_SPAN
+    nxt = fake_env(job="112")
+    assert slurm.store_port(env) != slurm.job_port(nxt)
+
+
+def test_sbatch_store_exports_addr_and_serves_wal_backed_store():
+    """store=True (ISSUE 13): the batch step exports DTDL_STORE_ADDR
+    (head node, the per-job store band — the same arithmetic
+    store_addr_from_env does) and backgrounds a WAL-backed tcpstore
+    coordinator that outlives every in-allocation restart."""
+    plain = slurm.sbatch_script(["t.py"])
+    assert "DTDL_STORE_ADDR" not in plain          # opt-in
+    text = slurm.sbatch_script(["t.py"], store=True, max_restarts=1)
+    assert 'export DTDL_STORE_ADDR="${head}:${store_port}"' in text
+    assert "store_port=$((16896 + SLURM_JOB_ID % 4096))" in text
+    assert "python -m dtdl_tpu.parallel.tcpstore" in text
+    assert "--wal-dir" in text
+    assert "trap 'kill ${store_pid}" in text
+    # the batch step WAITS for the coordinator's ready line (its cold
+    # start must not race the workers' connect budgets), bails if the
+    # server died, and only then sruns the workers
+    assert "grep -q 'STORE ready' store.log" in text
+    assert text.index("STORE ready") < text.index("srun")
+    # the store launches BEFORE the srun restart loop: it spans every
+    # in-allocation relaunch instead of dying with the failed step
+    assert text.index("tcpstore") < text.index("for attempt")
+
+
+def test_emit_sbatch_cli_store_flag(capsys):
+    rc = slurm.main(["--emit-sbatch", "--store", "--", "train.py"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DTDL_STORE_ADDR" in out
+    assert "dtdl_tpu.parallel.tcpstore" in out
