@@ -114,6 +114,10 @@ EVENT_CATALOG = frozenset({
     "request_first_token", "request_finished", "request_done",
     # SLO layer (round 16)
     "slo_breach", "slo_recovered", "slo_burn_rate",
+    # chunked prefill / disaggregation (round 19): the page-granular
+    # KV migration (side=extract on the prefill replica, side=inject
+    # on the decode one) and the Router's stage transition between them
+    "kv_handoff", "request_migrated",
     # elastic training plane (round 17): peer detection, world
     # re-formation, shrink-to-survivors restore, generation fencing —
     # every abort/fence/shed on the failure path surfaces here, never

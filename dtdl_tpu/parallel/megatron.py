@@ -50,6 +50,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtdl_tpu import _compat
+from dtdl_tpu.ops.attention import flash_attention
 from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
 from dtdl_tpu.parallel.sequence import (
     ring_attention, zigzag_order, zigzag_positions,
@@ -81,6 +82,19 @@ class MegatronConfig:
     # 0.01 default is the Switch Transformer setting.  0 disables.
     moe_aux_weight: float = 0.01
     dtype: jnp.dtype = jnp.bfloat16
+    # fused-rope attend (round 19, the PR 8 known-remaining): when the
+    # 'seq' mesh axis is 1 (TP/PP-only meshes — no ring hops), the
+    # local attend IS the whole sequence and can ride the Pallas flash
+    # kernel with the rotary embedding folded into its tile loads
+    # (flash_attention(rope_positions=)), killing the last apply_rope
+    # HBM round-trip (8·L·B·H·S·D bytes/step — SCALING.md round 13).
+    # 'auto' fuses only on real TPU backends (the CPU fallback runs
+    # the kernel under the Pallas interpreter, where the fusion saves
+    # no bytes and costs interpret overhead); True forces it anywhere
+    # (the parity tests), False keeps the unfused path.  Sequence-
+    # parallel meshes (seq > 1) always use apply_rope + ring: K/V
+    # blocks rotate around the ring pre-roped.
+    fuse_rope: object = "auto"
 
     def __post_init__(self):
         if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
@@ -249,9 +263,29 @@ def _attention(cfg, p, x, cos, sin):
     # causal ring attention is load-balanced; RoPE uses true global
     # positions of the zigzag rows (shard_lm_batch lays the batch out).
     pos = zigzag_positions(SEQ, s_loc)
-    q = apply_rope(q, cos, sin, positions=pos)
-    k = apply_rope(k, cos, sin, positions=pos)
-    o = ring_attention(q, k, v, axis_name=SEQ, causal=True, layout="zigzag")
+    sp = lax.axis_size(SEQ)               # static: the mesh is known
+    fuse = cfg.fuse_rope
+    if fuse == "auto":
+        fuse = sp == 1 and jax.default_backend() == "tpu"
+    if fuse and sp > 1:
+        raise ValueError(
+            "fuse_rope=True needs a 'seq' mesh axis of 1: under "
+            "sequence parallelism K/V blocks rotate around the ring "
+            "already roped, so the rotation cannot ride the local "
+            "kernel's tile loads")
+    if fuse:
+        # seq axis of 1: no ring hops — the local attend IS the whole
+        # sequence, so the rotary embedding rides the flash kernel's
+        # HBM→VMEM tile loads (round 13) instead of a per-layer
+        # apply_rope round-trip.  zigzag positions are the identity at
+        # n=1, so the kernel's index-causal mask == position-causal.
+        o = flash_attention(q, k, v, causal=True, rope=(cos, sin),
+                            rope_positions=(pos, pos))
+    else:
+        q = apply_rope(q, cos, sin, positions=pos)
+        k = apply_rope(k, cos, sin, positions=pos)
+        o = ring_attention(q, k, v, axis_name=SEQ, causal=True,
+                           layout="zigzag")
     o = o.transpose(0, 2, 1, 3).reshape(b, s_loc, h_loc * cfg.head_dim)
     y = jnp.einsum("bsh,hd->bsd", o, p["wo"].astype(cfg.dtype))
     return lax.psum(y, MODEL)                    # row-parallel combine
@@ -1319,23 +1353,29 @@ def place_params(mesh: Mesh, cfg: MegatronConfig, params: dict) -> dict:
 def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
                  n_slots: int = 8, buckets=None, page_size: int = 0,
                  n_pages: int = None, quantize_weights: bool = False,
-                 kv_dtype=None, kv_pool_bytes: int = None, **overrides):
+                 kv_dtype=None, kv_pool_bytes: int = None, rules=None,
+                 **overrides):
     """Train on the 4D engine, serve through dtdl_tpu.serve — the full
     bridge in one call: :func:`to_flax_model` (geometry) +
     :func:`to_flax_params` (weights) + an
     :class:`~dtdl_tpu.serve.InferenceEngine` around them.
 
-    With ``mesh``, the converted params are placed **replicated** on it
-    (``NamedSharding(mesh, P())``) and the engine's jitted prefill/decode
-    programs run under GSPMD on that mesh — the same pjit machinery the
-    training step used, so a training pod flips to serving without a new
-    runtime.  Replication is the right default at serving batch sizes:
-    decode is HBM-bandwidth-bound on the weights (SCALING.md "Serving
-    latency model"), and every chip holding all weights turns the mesh
-    into throughput-parallel decode capacity.  Tensor-parallel serving of
-    models too big to replicate would pass sharded placements instead —
-    the engine is placement-agnostic (jit re-specializes per input
-    sharding).
+    With ``mesh`` alone, the converted params are placed **replicated**
+    on it (``NamedSharding(mesh, P())``) and the engine's jitted
+    prefill/decode programs run under GSPMD on that mesh — the same
+    pjit machinery the training step used, so a training pod flips to
+    serving without a new runtime.  Replication is the right default at
+    serving batch sizes: decode is HBM-bandwidth-bound on the weights
+    (SCALING.md "Serving latency model"), and every chip holding all
+    weights turns the mesh into throughput-parallel decode capacity.
+
+    ``mesh`` plus ``rules`` (e.g. ``'tp'``) serves **tensor-parallel
+    proper** (round 19): this function is now a thin caller — the
+    engine itself shards params and the KV arena via the GSPMD presets
+    in parallel/tensor.py (``InferenceEngine(mesh=, rules=)``), so a
+    model too big to replicate serves with 1/tp of the weight and KV
+    bytes per chip, and a serving engine no longer needs the megatron
+    training mesh at all.
 
     ``params`` may be the live sharded training state (``device_get`` is
     applied before conversion).  ``overrides`` reach
@@ -1352,9 +1392,17 @@ def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
     """
     from dtdl_tpu.serve import InferenceEngine
 
+    if rules is not None and mesh is None:
+        # silently dropping the requested sharding would surface as an
+        # OOM (or one-chip serving) far from the misconfiguration
+        raise ValueError(f"rules={rules!r} requires mesh=: "
+                         f"tensor-parallel serving needs the mesh the "
+                         f"shards land on")
     model = to_flax_model(cfg, **overrides)
     fparams = to_flax_params(cfg, jax.device_get(params))
-    if mesh is not None:
+    if mesh is not None and rules is None:
+        # replicated placement (the throughput-parallel default); the
+        # tensor-parallel path below lets the ENGINE place the shards
         fparams = jax.tree.map(
             lambda p: jax.device_put(p, NamedSharding(mesh, P())), fparams)
     return InferenceEngine(model, fparams, n_slots=n_slots,
@@ -1362,4 +1410,6 @@ def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
                            n_pages=n_pages,
                            quantize_weights=quantize_weights,
                            kv_dtype=kv_dtype,
-                           kv_pool_bytes=kv_pool_bytes)
+                           kv_pool_bytes=kv_pool_bytes,
+                           mesh=mesh if rules is not None else None,
+                           rules=rules if rules is not None else "tp")

@@ -77,6 +77,44 @@ def logical_shardings(mesh: Mesh, tree, rules="tp"):
     return nn.logical_to_mesh_sharding(specs, mesh, list(rules))
 
 
+def heads_axis_size(mesh: Mesh, rules="tp") -> int:
+    """Size of the mesh axis the 'heads' logical dim shards on under
+    ``rules`` (1 when unsharded) — the serving engine's divisibility
+    check: a KV arena splits across exactly this many tensor-parallel
+    shards."""
+    if isinstance(rules, str):
+        rules = RULE_PRESETS[rules]
+    axis = dict(rules).get("heads")
+    return int(mesh.shape[axis]) if axis is not None else 1
+
+
+def serve_arena_shardings(mesh: Mesh, arena_shapes, rules="tp"):
+    """NamedShardings for a serving KV arena (round 19, the
+    tensor-parallel engine): the cache is built by the engine's init
+    helpers, not a flax init trace, so it carries no logical metadata —
+    but its layout is fixed by construction: every K/V payload and
+    scale leaf is ``[slots-or-pages, H, ...]`` with the HEADS dim on
+    axis 1 (dense rows [B, H, max_seq, D], paged pools
+    [n_pages, H, page, D], int8 scale siblings [.., H, ..]), and the
+    per-slot ``index`` vectors are tiny host-shaped scalars.  Sharding
+    heads on the same mesh axis the 'heads' logical dim uses keeps each
+    TP shard's attention entirely local (the Megatron layout: QKV
+    column-parallel in, out-projection row-parallel psum — inserted by
+    GSPMD), which is what makes the arena split ``1/tp`` of the KV
+    bytes per chip.  Everything else (indices) replicates.
+    """
+    if isinstance(rules, str):
+        rules = RULE_PRESETS[rules]
+    axis = dict(rules).get("heads")
+    repl = NamedSharding(mesh, P())
+    heads = NamedSharding(mesh, P(None, axis))
+
+    def one(leaf):
+        return heads if getattr(leaf, "ndim", 0) >= 3 else repl
+
+    return jax.tree.map(one, arena_shapes)
+
+
 def init_sharded_lm(model, mesh: Mesh, tx, example_tokens, rules="tp",
                     rng=None):
     """Initialize TransformerLM params directly into their shards.

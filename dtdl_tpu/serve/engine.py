@@ -125,6 +125,22 @@ def _paged_cache(arena, page_table, active, index=None):
     return conv(arena)
 
 
+def _dense_index(arena, index):
+    """Override every block's per-slot ``index`` leaf of a DENSE arena
+    with the given [n_slots] vector — the chunked-prefill hook (round
+    19): a prefill chunk's cache position is host-deterministic, so the
+    verify program takes it as DATA (``pos_set``) instead of trusting a
+    freed slot's stale index leaf.  Non-forced slots are passed their
+    own arena value back, so the override is the identity for them."""
+    def conv(tree):
+        if isinstance(tree, dict):
+            if "key" in tree and "index" in tree:
+                return dict(tree, index=index)
+            return {k: conv(v) for k, v in tree.items()}
+        return tree
+    return conv(arena)
+
+
 def _strip_paged(cache):
     """Drop the per-call leaves back out of a mutated paged cache so the
     returned arena keeps the stable pool+index structure."""
@@ -177,18 +193,54 @@ class InferenceEngine:
                  observer=None, page_size: int = 0,
                  n_pages: int | None = None,
                  quantize_weights: bool = False, kv_dtype=None,
-                 kv_pool_bytes: int | None = None):
+                 kv_pool_bytes: int | None = None, mesh=None,
+                 rules="tp"):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.quantized_weights = bool(quantize_weights)
         self.kv_dtype = canon_kv_dtype(kv_dtype)
         if quantize_weights:
+            if mesh is not None:
+                # the quantized tree carries _scale siblings the flax
+                # logical metadata does not declare — sharding it needs
+                # a quant-aware rule map, a later round
+                raise ValueError(
+                    "quantize_weights does not compose with mesh= "
+                    "(tensor-parallel) serving yet; serve the f32/bf16 "
+                    "params sharded, or quantized on one chip")
             # params are the UNQUANTIZED tree the caller trained/loaded;
             # the quantized clone declares the int8+scale schema
             params = quantize_params(model, params)
             model = model.clone(quantize=True)
         self.model = model
         self.params = nn.unbox(params)   # plain leaves either way
+        # tensor-parallel serving proper (round 19, ROADMAP item 3): a
+        # mesh plus a parallel/tensor.py rule preset shards the params
+        # (flax logical axes -> mesh axes via logical_shardings) and the
+        # KV arena (heads dim on the TP axis) — the engine's jitted
+        # programs then run under GSPMD on that mesh, with XLA inserting
+        # the Megatron collectives.  A serving engine no longer needs
+        # the 4D training mesh: megatron.serve_engine is a thin caller.
+        self.mesh = mesh
+        self.rules = rules if mesh is not None else None
+        self._arena_sh = None
+        if mesh is not None:
+            import functools
+
+            from dtdl_tpu.parallel.tensor import (heads_axis_size,
+                                                  logical_shardings)
+            tp = heads_axis_size(mesh, rules)
+            if self.model.n_heads % tp:
+                raise ValueError(
+                    f"n_heads={self.model.n_heads} must divide by the "
+                    f"mesh's tensor-parallel axis size {tp} "
+                    f"(rules={rules!r})")
+            abs_boxed = jax.eval_shape(
+                functools.partial(self.model.init,
+                                  jax.random.PRNGKey(0)),
+                jnp.zeros((1, 1), jnp.int32))["params"]
+            param_sh = logical_shardings(mesh, abs_boxed, rules)
+            self.params = jax.device_put(self.params, param_sh)
         # obs facade: when set (directly or by the Scheduler), the
         # recompile sentinel wraps each compiled program — a retrace of
         # the decode program or a re-trace of an already-built prefill
@@ -250,6 +302,13 @@ class InferenceEngine:
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None
         self._verify_fns: dict[int, object] = {}
+        # prefill/decode disaggregation (round 19): the page-granular
+        # KV handoff pair — one gather program (export a slot's prompt
+        # pages to host) and one scatter program (adopt them into this
+        # engine's pool + seed the slot's index/last) — both fixed
+        # [pages_per_slot] shapes, so a fleet's handoffs never recompile
+        self._extract_fn = None
+        self._inject_fn = None
         # dispatch counters (NOT in compile_stats, which must stay
         # constant across calls): prefill invocations per bucket — the
         # FLOP receipt prefix-cache tests read, since prefill compute
@@ -260,14 +319,31 @@ class InferenceEngine:
 
     def init_arena(self):
         """Fresh zeroed KV arena (donated to every program): dense
-        [n_slots, max_seq] rows, or the paged pool + per-slot indices."""
+        [n_slots, max_seq] rows, or the paged pool + per-slot indices.
+        On a TP mesh the K/V leaves come back sharded heads-on-'model'
+        (parallel/tensor.py:serve_arena_shardings), so the compiled
+        programs inherit the tensor-parallel layout from their inputs."""
+        if self.mesh is not None:
+            if self._arena_sh is None:
+                from dtdl_tpu.parallel.tensor import serve_arena_shardings
+                self._arena_sh = serve_arena_shardings(
+                    self.mesh, self.arena_shapes(), self.rules)
+            return jax.tree.map(
+                lambda s, sh: jax.device_put(
+                    jnp.zeros(s.shape, s.dtype), sh),
+                self.arena_shapes(), self._arena_sh)
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                             self.arena_shapes())
 
     def init_last_tokens(self):
         """The [n_slots] last-sampled-token vector (NOT donated: the
         scheduler's lag harvest holds references to past vectors)."""
-        return jnp.zeros((self.n_slots,), jnp.int32)
+        last = jnp.zeros((self.n_slots,), jnp.int32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            last = jax.device_put(
+                last, NamedSharding(self.mesh, PartitionSpec()))
+        return last
 
     # ---- bucketing ----------------------------------------------------
 
@@ -384,13 +460,24 @@ class InferenceEngine:
         model, paged = self.model, self.paged
 
         def verify(params, arena, last, draft, draft_len, active,
-                   tables, key, temp, top_k, top_p):
+                   forced, first_tok, pos_set, tables, key, temp,
+                   top_k, top_p):
             # the slots' pre-step cache positions: every block's index
-            # leaf carries the same per-slot values, take the first
+            # leaf carries the same per-slot values, take the first.
+            # Chunked-prefill rows (forced) take their position from
+            # pos_set instead — the prefill cursor is host truth, and a
+            # freshly-admitted slot's arena index leaf is the previous
+            # occupant's stale value
             pos = next(l for l in jax.tree.leaves(arena) if l.ndim == 1)
-            cache = (_paged_cache(arena, tables, active) if paged
-                     else arena)
-            x = jnp.concatenate([last[:, None], draft], axis=1)  # [B,k+1]
+            pos = jnp.where(forced, pos_set, pos)
+            cache = (_paged_cache(arena, tables, active, index=pos)
+                     if paged else _dense_index(arena, pos))
+            # forced rows feed their chunk's first token in place of the
+            # last sampled one: x = the k+1-token window written at
+            # pos..pos+k (prompt chunk for forced rows, last+drafts for
+            # speculative ones — same program, per-slot data)
+            x0 = jnp.where(forced, first_tok, last)
+            x = jnp.concatenate([x0[:, None], draft], axis=1)  # [B,k+1]
             logits, muts = model.apply(
                 {"params": params, "cache": cache}, x, decode=True,
                 mutable=["cache"])
@@ -398,7 +485,7 @@ class InferenceEngine:
                          else muts["cache"])
             tokens, n_acc = accept_resample(
                 logits.astype(jnp.float32), draft, draft_len, key,
-                temp, top_k, top_p)
+                temp, top_k, top_p, forced=forced)
             n_em = n_acc + 1
 
             def fix(old, new):
@@ -478,6 +565,19 @@ class InferenceEngine:
         # table/default shows up here without touching this call site
         blocks = resolve_blocks(hd, self.max_seq, causal=True)
         return {"prefill": {T: n(f) for T, f in self._prefill_fns.items()},
+                # disaggregation handoff pair (round 19): at most one
+                # compiled program each, whatever the migration traffic
+                "handoff": {
+                    "extract": n(self._extract_fn)
+                    if self._extract_fn else 0,
+                    "inject": n(self._inject_fn)
+                    if self._inject_fn else 0,
+                },
+                # tensor-parallel geometry (round 19): constant config,
+                # None on a single-chip engine
+                "tp": ({"rules": self.rules,
+                        "mesh": dict(self.mesh.shape)}
+                       if self.mesh is not None else None),
                 "kernels": {
                     "attention_blocks": {
                         "head_dim": hd, "max_seq": self.max_seq,
@@ -620,7 +720,8 @@ class InferenceEngine:
                                temp, top_k, top_p)
 
     def verify(self, arena, last_tokens, draft_tokens, draft_len, active,
-               key, temp, top_k, top_p, page_tables=None):
+               key, temp, top_k, top_p, page_tables=None, forced=None,
+               first_tok=None, pos_set=None):
         """One speculative verify pass over every slot: score each slot's
         ``draft_len[b]`` candidate tokens (``draft_tokens[b, :]``, zero-
         padded to the program's width k) in one parameter sweep, accept a
@@ -630,12 +731,28 @@ class InferenceEngine:
         :n_emitted[b]]`` is what slot b emitted this step (its last entry
         is the new ``last_tokens[b]``), inactive slots emit 0 tokens.
 
+        **Chunked prefill rides this same program** (round 19): a row
+        with ``forced[b]`` True is a prompt chunk, not a speculation —
+        its window is ``first_tok[b]`` plus ``draft_len[b]`` further
+        prompt tokens in ``draft_tokens[b]``, written at the
+        host-supplied cache position ``pos_set[b]`` (the prefill cursor;
+        a freed slot's arena index leaf is stale), accepted
+        unconditionally (``n_emitted = draft_len + 1``), with the bonus
+        token sampled from the last chunk position's target distribution
+        — on the prompt's final chunk that IS the request's first
+        generated token, from the same distribution whole-prompt prefill
+        samples.  Decode steps, speculative verifies and prefill chunks
+        therefore share ONE compiled step per width bucket: all three
+        are per-slot data on the same program.  Omitting the three
+        kwargs (or passing None) is exactly the pre-round-19 verify.
+
         The caller must guarantee every active slot has room for the
         full write window: ``index[b] + k + 1 <= max_seq`` (the
         scheduler settles worst-case indices before dispatch; a clamped
-        scatter would corrupt live cache rows).  ``k`` is a compile
-        shape — one compiled program per draft width, see
-        :meth:`compile_stats`.
+        scatter would corrupt live cache rows — for a forced row it
+        would shift the window backward over its own already-written
+        prompt positions).  ``k`` is a compile shape — one compiled
+        program per draft width, see :meth:`compile_stats`.
         """
         draft_tokens = jnp.asarray(draft_tokens, jnp.int32)
         if draft_tokens.ndim != 2 or draft_tokens.shape[0] != self.n_slots:
@@ -648,6 +765,13 @@ class InferenceEngine:
         if k + 1 > self.max_seq:
             raise ValueError(f"draft width {k} cannot fit "
                              f"max_seq={self.max_seq}")
+        B = self.n_slots
+        forced = (jnp.zeros((B,), bool) if forced is None
+                  else jnp.asarray(forced, bool))
+        first_tok = (jnp.zeros((B,), jnp.int32) if first_tok is None
+                     else jnp.asarray(first_tok, jnp.int32))
+        pos_set = (jnp.zeros((B,), jnp.int32) if pos_set is None
+                   else jnp.asarray(pos_set, jnp.int32))
         if k not in self._verify_fns:
             fn = self._build_verify(k)
             if self.observer is not None:
@@ -656,4 +780,127 @@ class InferenceEngine:
         return self._verify_fns[k](
             self.params, arena, last_tokens, draft_tokens,
             jnp.asarray(draft_len, jnp.int32), jnp.asarray(active),
+            forced, first_tok, pos_set,
             self._tables_arg(page_tables), key, temp, top_k, top_p)
+
+    # ---- prefill/decode disaggregation: page-granular KV handoff ------
+
+    def _build_extract(self):
+        def extract(arena, ids):
+            def conv(tree):
+                if isinstance(tree, dict):
+                    if "pages_key" in tree:
+                        # every pool leaf (K/V pages and, on int8
+                        # arenas, their scale siblings) gathered at the
+                        # same page ids; the per-slot index stays home
+                        return {k: jnp.take(v, ids, axis=0)
+                                for k, v in tree.items() if k != "index"}
+                    return {k: conv(v) for k, v in tree.items()}
+                return tree
+            return conv(arena)
+        return jax.jit(extract)
+
+    def _build_inject(self):
+        def inject(arena, last, data, ids, slot, index, first):
+            def conv(tree, dtree):
+                if isinstance(tree, dict):
+                    if "pages_key" in tree:
+                        out = {}
+                        for k, v in tree.items():
+                            if k == "index":
+                                # the adopted sequence decodes from its
+                                # prompt length, exactly as if this
+                                # engine had prefilled it
+                                out[k] = jax.lax.dynamic_update_slice(
+                                    v, index[None].astype(v.dtype),
+                                    (slot,))
+                            else:
+                                # pad rows carry page id 0: their zero
+                                # payload lands on the reserved garbage
+                                # page, never a live one
+                                out[k] = v.at[ids].set(
+                                    dtree[k].astype(v.dtype))
+                        return out
+                    return {k: conv(v, dtree[k]) for k, v in tree.items()}
+                return tree
+            arena = conv(arena, data)
+            last = jax.lax.dynamic_update_slice(last, first[None], (slot,))
+            return arena, last
+        return jax.jit(inject, donate_argnums=(0,))
+
+    def extract_pages(self, arena, page_ids):
+        """Export ``page_ids`` (a slot's prompt pages, logical order) to
+        HOST memory — the source half of prefill/decode disaggregation
+        (round 19): a prefill-role replica pulls the finished prompt's
+        K/V pages off device here and the Router carries them to a
+        decode replica's :meth:`inject_pages`.  Returns a host pytree
+        mirroring the pool-leaf structure, each leaf ``[len(page_ids),
+        ...]``.  This is the ONE deliberate device sync of the handoff
+        path (the ``kv_handoff_s`` metric); everything else stays
+        dispatch-only."""
+        if not self.paged:
+            raise ValueError("KV handoff requires a paged engine "
+                             "(page_size > 0)")
+        n = len(page_ids)
+        if not 0 < n <= self.n_ptab:
+            raise ValueError(f"need 1..{self.n_ptab} pages, got {n}")
+        ids = np.zeros(self.n_ptab, np.int32)    # pad -> garbage page 0
+        ids[:n] = page_ids
+        if self._extract_fn is None:
+            fn = self._build_extract()
+            if self.observer is not None:
+                fn = self.observer.watch(fn, "serve.kv_extract")
+            self._extract_fn = fn
+        host = jax.device_get(self._extract_fn(arena, jnp.asarray(ids)))
+        return jax.tree.map(lambda a: a[:n], host)
+
+    def inject_pages(self, arena, last_tokens, data, page_ids, slot: int,
+                     index: int, first_token: int):
+        """Adopt extracted prompt pages into THIS engine's pool: write
+        ``data`` (an :meth:`extract_pages` result) into ``page_ids``
+        (freshly allocated by the target scheduler), set slot ``slot``'s
+        cache index to ``index`` (the prompt length) and its last-token
+        entry to ``first_token`` — after which the slot decodes through
+        the ordinary decode/verify programs exactly as if this engine
+        had prefilled the prompt itself (greedy token-identity is the
+        disaggregation acceptance oracle).  One compiled program, all
+        arguments data.  Returns ``(arena, last_tokens)``."""
+        if not self.paged:
+            raise ValueError("KV handoff requires a paged engine "
+                             "(page_size > 0)")
+        n = len(page_ids)
+        leaves = jax.tree.leaves(data)
+        if not leaves or any(a.shape[0] != n for a in leaves):
+            raise ValueError(f"data leaves must carry {n} pages "
+                             f"(one per page id)")
+        if not 0 < n <= self.n_ptab:
+            raise ValueError(f"need 1..{self.n_ptab} pages, got {n}")
+        if any(not 0 < p < self.n_pages for p in page_ids):
+            raise ValueError(f"page ids must be in [1, {self.n_pages}), "
+                             f"got {list(page_ids)}")
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.n_slots})")
+        if not 0 < index < self.max_seq:
+            raise ValueError(f"index {index} must be in (0, "
+                             f"{self.max_seq}) — a full-to-the-brim "
+                             f"sequence has nothing left to decode")
+        ids = np.zeros(self.n_ptab, np.int32)
+        ids[:n] = page_ids
+
+        def pad(a):
+            a = np.asarray(a)
+            out = np.zeros((self.n_ptab,) + a.shape[1:], a.dtype)
+            out[:n] = a
+            return out
+
+        if self._inject_fn is None:
+            fn = self._build_inject()
+            if self.observer is not None:
+                fn = self.observer.watch(fn, "serve.kv_inject")
+            self._inject_fn = fn
+        return self._inject_fn(
+            arena, last_tokens, jax.tree.map(pad, data),
+            jnp.asarray(ids), jnp.asarray(slot, jnp.int32),
+            jnp.asarray(index, jnp.int32),
+            jnp.asarray(first_token, jnp.int32))

@@ -356,6 +356,12 @@ class FleetMetrics:
         self.evictions = 0
         self.failovers = 0
         self.restarts = 0
+        # prefill/decode disaggregation (round 19): completed-prefill
+        # flights migrated to a decode replica, and the pages each
+        # migration carried (per-replica extract/inject timings live in
+        # the nested ServeMetrics as kv_handoff_pages/kv_handoff_s)
+        self.migrations = 0
+        self.kv_handoff_pages = 0
         self.ttft_hist = LogHistogram()
         self.tok_latency_hist = LogHistogram()
         self._t_start: Optional[float] = None
@@ -416,6 +422,12 @@ class FleetMetrics:
     def on_restart(self):
         self.restarts += 1
 
+    def on_migrate(self, pages: int):
+        """One completed-prefill flight handed from a prefill replica to
+        a decode replica, carrying ``pages`` KV pages."""
+        self.migrations += 1
+        self.kv_handoff_pages += pages
+
     # ---- aggregation --------------------------------------------------
 
     @property
@@ -446,6 +458,8 @@ class FleetMetrics:
             "fleet_evictions": self.evictions,
             "fleet_failovers": self.failovers,
             "fleet_restarts": self.restarts,
+            "fleet_migrations": self.migrations,
+            "fleet_kv_handoff_pages": self.kv_handoff_pages,
             "fleet_wall_s": round(wall, 6),
             "fleet_decode_tokens": decode_tokens,
             "fleet_decode_tokens_per_sec": round(decode_tokens / wall, 2)
@@ -467,6 +481,7 @@ class FleetMetrics:
         "fleet_requests_failed", "fleet_requests_aborted",
         "fleet_retries", "fleet_hedges", "fleet_hedges_won",
         "fleet_evictions", "fleet_failovers", "fleet_restarts",
+        "fleet_migrations", "fleet_kv_handoff_pages",
         "fleet_decode_tokens",
     })
 
@@ -522,7 +537,15 @@ def default_fleet_slos(ttft_p99_s: Optional[float] = None,
 class _Flight:
     """Router-side lifecycle record of one USER request: the attempts
     (replica-local Request clones) that have served it, which are still
-    live, how many retries it has burned, and whether it was hedged."""
+    live, how many retries it has burned, and whether it was hedged.
+
+    ``stage``/``handoff`` are the disaggregation state (round 19): a
+    flight in a role fleet starts at stage 'prefill' (dispatched to a
+    prefill or mixed replica) and, when its prefill attempt completes
+    with a ``kv_handoff`` payload, moves to stage 'decode' carrying
+    that payload — every decode(-retry) attempt re-injects the SAME
+    host-side pages, which is why a decode-replica failure after
+    migration re-serves token-identically without re-prefilling."""
     req: Request
     t_router: float
     live: dict = dataclasses.field(default_factory=dict)   # rid -> replica
@@ -530,6 +553,8 @@ class _Flight:
     retries: int = 0
     hedged: bool = False
     hedge_rid: Optional[int] = None
+    stage: str = "prefill"
+    handoff: Optional[dict] = None
 
 
 class Router:
@@ -560,7 +585,7 @@ class Router:
                  auto_restart: bool = True, metrics: FleetMetrics = None,
                  observer=None, plan: Optional[FaultPlan] = None,
                  poll_s: float = 0.002, warmup: bool = True,
-                 exporter=None, slos=None):
+                 exporter=None, slos=None, roles=None):
         if isinstance(engines, (list, tuple)):
             engines = list(engines)
             if n_replicas is not None and n_replicas != len(engines):
@@ -570,7 +595,10 @@ class Router:
             if n_replicas is not None and n_replicas < 1:
                 raise ValueError(f"n_replicas must be >= 1, got "
                                  f"{n_replicas}")
-            engines = [engines] * (2 if n_replicas is None else n_replicas)
+            n_eff = n_replicas
+            if n_eff is None:
+                n_eff = len(roles) if roles is not None else 2
+            engines = [engines] * n_eff
         if not engines:
             raise ValueError("need at least one engine")
         if retry_budget < 0:
@@ -578,6 +606,57 @@ class Router:
                              f"{retry_budget}")
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        # prefill/decode disaggregation (round 19): per-replica roles.
+        # 'prefill' replicas run only the compute-bound prompt half
+        # (attempts carry prefill_only; completion yields a kv_handoff
+        # page payload), 'decode' replicas only the bandwidth-bound
+        # generation half (migrated attempts carry kv_inject), 'mixed'
+        # replicas serve whole flights — roles=None (the default) is an
+        # all-mixed fleet, byte-identical to the PR 9 behavior.
+        if roles is not None:
+            roles = list(roles)
+            if len(roles) != len(engines):
+                raise ValueError(f"roles has {len(roles)} entries for "
+                                 f"{len(engines)} replicas")
+            bad = [r for r in roles if r not in ("prefill", "decode",
+                                                 "mixed")]
+            if bad:
+                raise ValueError(f"unknown roles {bad}; expected "
+                                 f"'prefill'/'decode'/'mixed'")
+            if not any(r in ("prefill", "mixed") for r in roles):
+                raise ValueError("no prefill-capable replica: fresh "
+                                 "prompts would never dispatch")
+            if not any(r in ("decode", "mixed") for r in roles):
+                raise ValueError("no decode-capable replica: migrated "
+                                 "flights would never finish")
+            has_prefill_role = any(r == "prefill" for r in roles)
+            if "decode" in roles and not has_prefill_role:
+                # a decode replica is reachable ONLY through
+                # migrations, and only prefill-role replicas produce
+                # them — without one it would idle forever: silently
+                # dead capacity, refused at construction instead
+                raise ValueError(
+                    "'decode' replicas need at least one 'prefill' "
+                    "replica to migrate from (mixed replicas serve "
+                    "whole flights and never hand off)")
+            for i, r in enumerate(roles):
+                # a 'mixed' replica in a fleet WITH prefill-role
+                # replicas is decode-capable, so migrated (kv_inject)
+                # flights can land on it — it needs the paged arena
+                # exactly like a 'decode' one; only an all-mixed fleet
+                # (where migrations cannot exist) may stay dense
+                needs_paged = r != "mixed" or has_prefill_role
+                if needs_paged and not engines[i].paged:
+                    raise ValueError(
+                        f"replica {i} has role {r!r} in a fleet that "
+                        f"migrates KV (page-granular handoff) but a "
+                        f"dense engine: build it with page_size > 0")
+            if hedge_after_s is not None:
+                raise ValueError(
+                    "hedging does not compose with a role fleet yet: "
+                    "a hedged prefill flight would race two handoff "
+                    "payloads for one migration")
+        self.roles = roles
         self.observer = observer or NULL_OBSERVER
         self.metrics = metrics or FleetMetrics()
         self.max_queue = max_queue
@@ -598,12 +677,29 @@ class Router:
             # or raise watchdog_s.)
             wk = dict(sched_kwargs or {})
             wk.pop("metrics", None)    # never count warmup as traffic
+            ct = wk.get("chunk_tokens")
             seen: set[int] = set()
             for eng in engines:
                 if id(eng) in seen:
                     continue
                 seen.add(id(eng))
                 Scheduler(eng, **wk).run([Request([0], 2)])
+                if ct:
+                    # chunked prefill compiles one verify program per
+                    # pow2 chunk-width bucket; warm EVERY bucket the
+                    # planner can produce (k = 1..pow2(ct-1)) — the
+                    # same wedge-vs-compile lesson as the base warmup,
+                    # but chunking makes every fleet hit it, not just
+                    # speculative ones
+                    ks, k = [], 1
+                    while True:
+                        ks.append(k)
+                        if k >= max(1, ct - 1):
+                            break
+                        k *= 2
+                    for k in ks:
+                        n = min(k + 1, ct, eng.buckets[-1])
+                        Scheduler(eng, **wk).run([Request([0] * n, 2)])
         self.replicas = [
             Replica(i, eng, sched_kwargs, plan, self.observer)
             for i, eng in enumerate(engines)]
@@ -837,6 +933,23 @@ class Router:
         user = fl.req
         if att.error is None:
             self.health[i].on_success()
+            if att.kv_handoff is not None and not user.done:
+                # the prefill half finished with generation still owed:
+                # migrate — requeue at the HEAD (the decode half is the
+                # latency-critical tail of an already-started request)
+                # carrying the page payload
+                fl.stage = "decode"
+                fl.handoff = att.kv_handoff
+                n_pg = int(att.kv_handoff["n_pages"])
+                self.metrics.on_migrate(n_pg)
+                self.observer.event(
+                    "request_migrated", rid=corr_rid(user.rid),
+                    arid=corr_rid(att.rid), replica=i, pages=n_pg)
+                self.observer.flow("req", corr_rid(user.rid), "step")
+                with self._cv:
+                    self.queue.appendleft(fl)
+                    self._cv.notify_all()
+                return
             if fl.hedged and att.rid == fl.hedge_rid and not user.done:
                 self.metrics.on_hedge_won()
                 self.observer.event("hedge_won", rid=corr_rid(user.rid),
@@ -1065,7 +1178,20 @@ class Router:
                 fl, "expired: deadline exceeded in router queue",
                 self.metrics.on_expire)
 
-    def _pick(self, exclude: Optional[int] = None) -> Optional[int]:
+    def _role_ok(self, i: int, stage: Optional[str]) -> bool:
+        """May replica ``i`` serve a flight at ``stage``?  Always True
+        in a role-less fleet; in a role fleet, fresh prompts go to
+        prefill/mixed replicas and migrated flights to decode/mixed
+        ones (a mixed replica serving a fresh prompt runs the whole
+        flight — no handoff needed)."""
+        if self.roles is None or stage is None:
+            return True
+        if stage == "prefill":
+            return self.roles[i] in ("prefill", "mixed")
+        return self.roles[i] in ("decode", "mixed")
+
+    def _pick(self, exclude: Optional[int] = None,
+              stage: Optional[str] = None) -> Optional[int]:
         """Least-loaded over dispatchable (HEALTHY) replicas WITH
         CAPACITY — the circuit breaker and lifecycle states are
         excluded (the never-dispatch-to-SUSPECT/EVICTED/DRAINING
@@ -1075,9 +1201,10 @@ class Router:
         where ``max_queue`` can actually shed it (eagerly draining the
         queue into replica inboxes would make the bounded-admission
         contract a no-op).  ``exclude`` lets the hedge path require a
-        DIFFERENT replica."""
+        DIFFERENT replica; ``stage`` applies the role filter."""
         cands = [i for i, h in enumerate(self.health)
                  if h.dispatchable and i != exclude
+                 and self._role_ok(i, stage)
                  and self.replicas[i].load
                  < 2 * self.replicas[i].engine.n_slots]
         if not cands:
@@ -1091,7 +1218,12 @@ class Router:
                 with self._cv:
                     if not self.queue:
                         return
-                    target = self._pick()
+                    # role fleets pick per the HEAD flight's stage
+                    # (strict FIFO: a decode-capacity stall holds the
+                    # queue rather than reordering user requests)
+                    head_stage = (self.queue[0].stage
+                                  if self.roles is not None else None)
+                    target = self._pick(stage=head_stage)
                     if target is None:
                         # SUSPECT and DRAINING recover; a fleet that is
                         # ENTIRELY evicted (no auto_restart) never will
@@ -1110,11 +1242,29 @@ class Router:
                         # retries the flight has BURNED (hedges and
                         # free backpressure requeues never advance the
                         # index — a requeue before any burn is its own
-                        # flavor)
-                        lineage = ("primary" if not fl.attempts
-                                   else f"retry:{fl.retries}"
-                                   if fl.retries else "requeue")
-                        att = self._clone(fl.req, lineage)
+                        # flavor; a migrated decode half is 'migrate')
+                        if self.roles is not None \
+                                and fl.stage == "decode":
+                            att = self._clone(fl.req, "migrate")
+                            att.kv_inject = fl.handoff
+                            # the first token was delivered by the
+                            # prefill half: seed it so the decode
+                            # replica owes exactly the remainder
+                            att.tokens = [int(fl.handoff["first_token"])]
+                            att.t_first = float(
+                                fl.handoff.get("t_first") or 0.0)
+                        else:
+                            lineage = ("primary" if not fl.attempts
+                                       else f"retry:{fl.retries}"
+                                       if fl.retries else "requeue")
+                            att = self._clone(fl.req, lineage)
+                            if self.roles is not None \
+                                    and self.roles[target] == "prefill":
+                                # a prefill-role replica runs only the
+                                # prompt half; a MIXED replica drawn
+                                # for a fresh prompt runs the whole
+                                # flight (no handoff detour)
+                                att.prefill_only = True
                         now = time.perf_counter()
                         fl.live[att.rid] = target
                         fl.attempts.append((att.rid, target, now))
@@ -1326,6 +1476,8 @@ class Router:
         out = self.metrics.summary(
             [rep.metrics.summary() for rep in self.replicas],
             health=[h.state for h in self.health])
+        if self.roles is not None:
+            out["replica_roles"] = list(self.roles)
         if self.exporter is not None:
             out["export_snapshots"] = self.exporter.n_snapshots
         if self.slo is not None:

@@ -119,6 +119,20 @@ class ServeMetrics:
         self.pages_in_use_peak = 0
         self.pages_in_use_last = 0
         self.page_capacity = 0
+        # chunked prefill + disaggregation interference receipts (round
+        # 19): chunk counts/tokens are the chunked path's ledger;
+        # decode_steps_delayed_by_prefill is the PRE-change counter —
+        # each whole-prompt (blocking) prefill charges the number of
+        # in-flight decode slots it stalled, so the before/after bench
+        # can show the interference the chunked path removes;
+        # kv_handoff_* meter the page-granular prefill→decode migration
+        # (pages moved, seconds spent in the extract sync / inject
+        # dispatch)
+        self.n_prefill_chunks = 0
+        self.n_chunk_tokens = 0
+        self.n_decode_steps_delayed = 0
+        self.n_kv_handoff_pages = 0
+        self.kv_handoff_s = 0.0
         self.ttft_s: list[float] = []          # exact samples, capped
         self.tok_latency_s: list[float] = []   # per-request mean, capped
         # streaming stats (fixed memory, never capped): means AND tails
@@ -189,6 +203,28 @@ class ServeMetrics:
         self.pages_in_use_last = pages_in_use
         self.pages_in_use_peak = max(self.pages_in_use_peak, pages_in_use)
         self.page_capacity = capacity
+
+    def on_chunk(self, tokens: int):
+        """One prefill chunk dispatched at width ``tokens`` (round 19):
+        prompt processing that shared a compiled step with the
+        in-flight decodes instead of stalling them."""
+        self.n_prefill_chunks += 1
+        self.n_chunk_tokens += tokens
+
+    def on_prefill_block(self, n_decoding: int):
+        """One BLOCKING whole-prompt prefill dispatched while
+        ``n_decoding`` slots were mid-decode — each of them waits a
+        full prefill latency for their next token.  Zero under chunked
+        prefill; the before/after interference receipt."""
+        self.n_decode_steps_delayed += n_decoding
+
+    def on_kv_handoff(self, pages: int, seconds: float):
+        """One side of a prefill→decode page migration: ``pages`` moved
+        (source extract or target inject), ``seconds`` of host time —
+        the extract side's device_get is the one deliberate sync of the
+        disaggregation path."""
+        self.n_kv_handoff_pages += pages
+        self.kv_handoff_s += seconds
 
     def on_draft(self, seconds: float):
         """One drafting phase's host time (dispatch-side; drafted/
@@ -281,6 +317,12 @@ class ServeMetrics:
                 decode_tokens / self.n_decode_steps, 4)
             if self.n_decode_steps else 0.0,
             "requests_shed": self.n_shed,
+            # chunked prefill + disaggregation receipts (round 19)
+            "prefill_chunks": self.n_prefill_chunks,
+            "chunk_tokens": self.n_chunk_tokens,
+            "decode_steps_delayed_by_prefill": self.n_decode_steps_delayed,
+            "kv_handoff_pages": self.n_kv_handoff_pages,
+            "kv_handoff_s": round(self.kv_handoff_s, 6),
             # paged KV / prefix cache (all zeros for a dense arena):
             # hit rate is over FULL prompt pages — the unit of sharing
             "prefix_hit_rate": round(
@@ -317,6 +359,9 @@ class ServeMetrics:
         "requests_shed", "prefill_tokens", "decode_steps",
         "decode_tokens", "prefill_tokens_saved", "spec_steps",
         "spec_drafted_tokens", "spec_accepted_tokens", "draft_s",
+        "prefill_chunks", "chunk_tokens",
+        "decode_steps_delayed_by_prefill", "kv_handoff_pages",
+        "kv_handoff_s",
     })
 
     def window(self) -> dict:

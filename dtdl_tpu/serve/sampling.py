@@ -191,7 +191,7 @@ def sample(logits, key, temperature, top_k, top_p):
 
 
 def accept_resample(logits, draft, draft_len, key, temperature, top_k,
-                    top_p):
+                    top_p, forced=None):
     """The speculative-decoding accept/resample kernel — ON DEVICE,
     per slot, provably lossless.
 
@@ -223,50 +223,92 @@ def accept_resample(logits, draft, draft_len, key, temperature, top_k,
       one-hot-proposal special case).  If every real draft is accepted
       the final token is a normal sample from ``p_{draft_len}`` (the
       bonus token — conditioning on all accepted drafts).
+
+    ``forced`` ([B] bool, optional) marks rows whose draft is not a
+    speculation but GROUND TRUTH — a chunked-prefill window of prompt
+    tokens riding the verify program (round 19): acceptance is skipped
+    entirely (``n_accepted = draft_len`` whatever the model thinks of
+    the prompt) and the final token is a normal bonus sample from
+    ``p_{draft_len}`` — which for the prompt's LAST chunk is exactly the
+    request's first generated token, sampled from the same target
+    distribution whole-prompt prefill samples from (greedy rows: the raw
+    argmax, the token-identity contract).  ``None`` (the default) is
+    byte-identical to the pre-round-19 behavior.
     """
     B, k1, V = logits.shape
     k = k1 - 1
     greedy_row = temperature <= 0.0                          # [B]
     argmaxes = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
-    filt = jax.vmap(
-        lambda lg: filter_logits(lg, temperature, top_k, top_p),
-        in_axes=1, out_axes=1)(logits)                       # [B, k+1, V]
-    probs = jax.nn.softmax(filt, axis=-1)
-
     key_u, key_f = jax.random.split(key)
-    u = jax.random.uniform(key_u, (B, k))
-    p_draft = jnp.take_along_axis(
-        probs[:, :k], draft[..., None], axis=-1)[..., 0]     # [B, k]
-    acc = jnp.where(greedy_row[:, None], draft == argmaxes[:, :k],
-                    u < p_draft)
-    acc = acc & (jnp.arange(k)[None, :] < draft_len[:, None])
-    # longest accepted prefix: cumprod zeroes everything after the first
-    # rejection
-    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
 
-    # final token at position n_acc: raw argmax for greedy rows (== the
-    # token sequential decode would emit there); residual/bonus draw for
-    # sampling rows
-    fin_raw = jnp.take_along_axis(
-        logits, n_acc[:, None, None], axis=1)[:, 0]          # [B, V]
-    fin_filt = jnp.take_along_axis(
-        filt, n_acc[:, None, None], axis=1)[:, 0]
-    rejected = n_acc < draft_len           # a REAL draft was refused here
-    d_rej = jnp.take_along_axis(
-        draft, jnp.minimum(n_acc, k - 1)[:, None], axis=1)[:, 0]
-    residual = jnp.where(
-        rejected[:, None] & (jnp.arange(V)[None, :] == d_rej[:, None]),
-        -jnp.inf, fin_filt)
-    drawn = jax.random.categorical(key_f, residual,
-                                   axis=-1).astype(jnp.int32)
-    fin = jnp.where(greedy_row,
-                    jnp.argmax(fin_raw, axis=-1).astype(jnp.int32), drawn)
+    def finish(acc, fin_fn):
+        acc = acc & (jnp.arange(k)[None, :] < draft_len[:, None])
+        # longest accepted prefix: cumprod zeroes everything after the
+        # first rejection
+        n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                        axis=1)
+        if forced is not None:
+            # prompt-truth rows: the whole window commits
+            # unconditionally, and `rejected` below is False by
+            # construction (n_acc == draft_len), so the final token is
+            # the plain bonus draw
+            n_acc = jnp.where(forced, draft_len, n_acc)
+        fin = fin_fn(n_acc)
+        pos_i = jnp.arange(k1)[None, :]
+        tokens = jnp.where(pos_i < n_acc[:, None],
+                           jnp.pad(draft, ((0, 0), (0, 1))), 0)
+        tokens = jnp.where(pos_i == n_acc[:, None], fin[:, None], tokens)
+        return tokens.astype(jnp.int32), n_acc.astype(jnp.int32)
 
-    pos_i = jnp.arange(k1)[None, :]
-    tokens = jnp.where(pos_i < n_acc[:, None],
-                       jnp.pad(draft, ((0, 0), (0, 1))), 0)
-    tokens = jnp.where(pos_i == n_acc[:, None], fin[:, None], tokens)
-    return tokens.astype(jnp.int32), n_acc.astype(jnp.int32)
+    def greedy_path(_):
+        # ALL rows greedy (the common serving batch, and every chunked
+        # prefill window): acceptance is the argmax prefix match and
+        # the final token the raw argmax — the k+1-position
+        # filter/bisection sweep below never runs.  lax.cond executes
+        # one branch, so an all-greedy verify/chunk step skips the
+        # whole truncation machinery on device; the result is
+        # bit-identical to the full path's greedy rows (which also
+        # reduce to argmax), pinned by the spec-decode identity tests.
+        return finish(
+            draft == argmaxes[:, :k],
+            lambda n_acc: jnp.take_along_axis(
+                argmaxes, n_acc[:, None], axis=1)[:, 0])
+
+    def full_path(_):
+        filt = jax.vmap(
+            lambda lg: filter_logits(lg, temperature, top_k, top_p),
+            in_axes=1, out_axes=1)(logits)                   # [B, k+1, V]
+        probs = jax.nn.softmax(filt, axis=-1)
+        u = jax.random.uniform(key_u, (B, k))
+        p_draft = jnp.take_along_axis(
+            probs[:, :k], draft[..., None], axis=-1)[..., 0]  # [B, k]
+        acc = jnp.where(greedy_row[:, None], draft == argmaxes[:, :k],
+                        u < p_draft)
+
+        def fin_fn(n_acc):
+            # final token at position n_acc: raw argmax for greedy rows
+            # (== the token sequential decode would emit there);
+            # residual/bonus draw for sampling rows
+            fin_raw = jnp.take_along_axis(
+                logits, n_acc[:, None, None], axis=1)[:, 0]  # [B, V]
+            fin_filt = jnp.take_along_axis(
+                filt, n_acc[:, None, None], axis=1)[:, 0]
+            rejected = n_acc < draft_len   # a REAL draft refused here
+            d_rej = jnp.take_along_axis(
+                draft, jnp.minimum(n_acc, k - 1)[:, None], axis=1)[:, 0]
+            residual = jnp.where(
+                rejected[:, None]
+                & (jnp.arange(V)[None, :] == d_rej[:, None]),
+                -jnp.inf, fin_filt)
+            drawn = jax.random.categorical(key_f, residual,
+                                           axis=-1).astype(jnp.int32)
+            return jnp.where(
+                greedy_row,
+                jnp.argmax(fin_raw, axis=-1).astype(jnp.int32), drawn)
+
+        return finish(acc, fin_fn)
+
+    return lax.cond(jnp.all(greedy_row), greedy_path, full_path, None)
 
 
 def pack(params_per_slot) -> tuple:

@@ -29,7 +29,8 @@ sync-every-step cost.
 :class:`~dtdl_tpu.serve.draft.DraftSource` — chosen from *lag-harvested
 host state* (the source predicts ``gap + k`` tokens continuing the
 harvested truth and the optimistic in-flight ``gap`` is skipped — see
-``_make_drafts``), never by syncing the in-flight step —
+``_dispatch_round``'s draft block), never by syncing the in-flight
+step —
 and the engine's ``verify`` program scores all candidates in one
 parameter sweep, accepting a per-slot prefix ON DEVICE
 (serve/sampling.py:accept_resample, lossless).  Consequences the
@@ -56,6 +57,22 @@ scheduler absorbs:
   (non-speculative slots ride along with ``draft_len=0`` and behave
   exactly like a decode step — token-identical, pinned by
   tests/test_spec_decode.py).
+
+**Chunked prefill** (round 19, ``chunk_tokens=N``) makes prompt
+processing incremental and schedulable: admission only binds a slot
+(and maps its pages), then the prompt enters in per-step chunks of at
+most N tokens riding the SAME verify program as ``forced`` rows —
+"verify with no acceptance test" — so decode steps, speculative drafts
+and prefill chunks share one compiled step and a long admission stops
+stalling every in-flight decode by a whole-prompt prefill latency
+(``decode_steps_delayed_by_prefill`` is the pre-change counter).  The
+final chunk's bonus sample IS the request's first token, from the same
+target distribution whole-prompt prefill samples — greedy output is
+token-identical either way (tests/test_chunked_prefill.py).  A
+``prefill_only`` request (the fleet's disaggregation, round 19)
+finishes at that first token with a page-granular ``kv_handoff``
+payload; a ``kv_inject`` request adopts one and decodes as if it had
+prefilled locally.
 
 **Paged KV** (an engine built with ``page_size > 0``) moves the
 admission currency from slots to PAGES.  The scheduler owns the
@@ -128,12 +145,21 @@ class Request:
     16): a fleet Router stamps each replica-local attempt clone with
     the USER request's rid and how the attempt came to be (``primary``
     / ``retry:N`` after N burned retries / ``requeue`` for a free
-    backpressure re-dispatch / ``hedge``), so every request-scoped
+    backpressure re-dispatch / ``hedge`` / ``migrate`` for the decode
+    half of a disaggregated flight), so every request-scoped
     trace event the
     scheduler emits carries the user rid and
     ``Tracer.request_timeline(rid)`` can reassemble a hedged,
     failed-over request across threads.  Standalone requests leave them
     at the defaults (their own rid is the correlation id).
+
+    **Disaggregation fields (round 19).** ``prefill_only`` asks this
+    scheduler for the PREFILL half only: the request finishes the
+    moment its first token harvests, with ``kv_handoff`` set to the
+    host-side page payload (prompt K/V pages + first token) a decode
+    replica's ``kv_inject`` admission adopts — the fleet Router is the
+    carrier (dtdl_tpu/serve/fleet.py).  Both require a paged engine;
+    standalone callers normally leave them alone.
     """
     prompt: Sequence[int]
     max_new_tokens: int
@@ -144,6 +170,11 @@ class Request:
     deadline_at: Optional[float] = None
     origin_rid: Optional[int] = None
     lineage: str = "primary"
+    prefill_only: bool = False
+    kv_inject: Optional[dict] = dataclasses.field(default=None,
+                                                  repr=False)
+    kv_handoff: Optional[dict] = dataclasses.field(default=None,
+                                                   repr=False)
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
@@ -193,11 +224,20 @@ class _SlotState:
     misprediction self-heals at the next harvest instead of poisoning
     later drafts.  ``k_cur`` is the adaptive draft length, steered by a
     trailing-acceptance EMA.
+
+    ``fill_next``/``fill_end`` are the CHUNKED-PREFILL cursor (round
+    19): while ``fill_next < fill_end`` the slot is still absorbing its
+    prompt in per-step chunks (``fill_next`` = the next prompt offset
+    to write, advanced at chunk dispatch — host truth, always equal to
+    ``pos_hi``) and never decodes, drafts, or emits.  Whole-prompt
+    admission leaves them equal (nothing to fill).
     """
 
-    __slots__ = ("rid", "pos", "k_cur", "k_max", "acc_ema", "inflight")
+    __slots__ = ("rid", "pos", "k_cur", "k_max", "acc_ema", "inflight",
+                 "fill_next", "fill_end", "fill_toks")
 
-    def __init__(self, rid: int, pos: int, k_max: int):
+    def __init__(self, rid: int, pos: int, k_max: int,
+                 fill_end: Optional[int] = None):
         self.rid = rid
         self.pos = pos
         self.k_max = k_max
@@ -208,25 +248,48 @@ class _SlotState:
         self.k_cur = max(1, min(2, k_max))
         self.acc_ema = 1.0          # optimistic until measured
         self.inflight: deque = deque()
+        self.fill_next = pos
+        self.fill_end = pos if fill_end is None else fill_end
+        # the prompt as one int32 array, materialized ONCE at chunked
+        # admission: chunk building slices it per step — re-listing the
+        # whole prompt per chunk would cost O(len^2/chunk) host work on
+        # exactly the long-prompt path chunking exists for
+        self.fill_toks = None
+
+    @property
+    def prefilling(self) -> bool:
+        """Still absorbing prompt chunks — excluded from decode/draft."""
+        return self.fill_next < self.fill_end
 
     @property
     def pos_hi(self) -> int:
         """Worst-case (all-accepted) device index — the overflow bound."""
-        return self.pos + sum(dl + 1 for dl in self.inflight)
+        return self.pos + sum(dl + 1 for dl, _ in self.inflight)
 
     @property
     def gap_est(self) -> int:
-        """EXPECTED tokens the device is ahead of harvested truth: one
-        guaranteed per in-flight step plus acceptance-EMA-weighted
-        drafts.  At high acceptance this is the all-accepted count
-        (aligned drafting, the payoff regime); at low acceptance it
-        decays to one-per-step, which is what the device is actually
-        doing — either way the skip stays close to the true offset."""
+        """EXPECTED tokens of the request's OUTPUT stream the device is
+        ahead of harvested truth: one guaranteed per in-flight
+        decode/verify step plus acceptance-EMA-weighted drafts.  At
+        high acceptance this is the all-accepted count (aligned
+        drafting, the payoff regime); at low acceptance it decays to
+        one-per-step, which is what the device is actually doing —
+        either way the skip stays close to the true offset.  In-flight
+        PREFILL CHUNKS advance the cache index, never the output
+        stream: an intermediate chunk contributes 0 and the final
+        chunk exactly its bonus token — counting chunk widths here
+        would make the first post-prefill draft windows skip ~a whole
+        chunk of the proposal and reject guaranteed."""
         a = min(1.0, max(0.0, self.acc_ema))
-        return sum(1 + int(round(dl * a)) for dl in self.inflight)
+        out = 0
+        for dl, kind in self.inflight:
+            if kind == 1:
+                continue               # intermediate chunk: no output
+            out += 1 if kind == 2 else 1 + int(round(dl * a))
+        return out
 
-    def dispatched(self, draft_len: int) -> None:
-        self.inflight.append(draft_len)
+    def dispatched(self, draft_len: int, kind: int = 0) -> None:
+        self.inflight.append((draft_len, kind))
 
     def settle(self, draft_len: int, n_emitted: int) -> None:
         """One in-flight step harvested: exact index, acceptance EMA,
@@ -260,12 +323,16 @@ class Scheduler:
                  harvest_lag: int = 4, metrics: ServeMetrics = None,
                  observer=None, draft: Optional[DraftSource] = None,
                  max_queue: Optional[int] = None,
-                 prefix_cache: bool = True, exporter=None):
+                 prefix_cache: bool = True, exporter=None,
+                 chunk_tokens: Optional[int] = None):
         if harvest_lag < 0:
             raise ValueError(f"harvest_lag must be >= 0, got "
                              f"{harvest_lag}")
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if chunk_tokens is not None and chunk_tokens < 1:
+            raise ValueError(f"chunk_tokens must be >= 1, got "
+                             f"{chunk_tokens}")
         # obs facade: thread-safe spans (admit/draft/dispatch/verify/
         # harvest) + the engine's recompile sentinel; defaults to no-ops
         self.observer = observer or NULL_OBSERVER
@@ -331,6 +398,16 @@ class Scheduler:
                                  GARBAGE_PAGE, np.int32)
             self._slot_pages: list[list[int]] = \
                 [[] for _ in range(engine.n_slots)]
+        # chunked prefill (round 19, Sarathi-style): prompt processing
+        # split into <= chunk_tokens-per-step windows riding the verify
+        # program family, so a long admission no longer stalls every
+        # in-flight decode by a whole-prompt prefill latency.  None =
+        # the PR 2 whole-prompt behavior, token-identical under greedy
+        # (tests/test_chunked_prefill.py pins both ways).
+        self.chunk_tokens = chunk_tokens
+        # paged+chunked: prefix-hash registration is deferred until the
+        # prompt's pages are fully written (the final chunk's dispatch)
+        self._slot_hashes: list = [None] * engine.n_slots
 
     # ---- intake -------------------------------------------------------
 
@@ -407,6 +484,46 @@ class Scheduler:
             return self._reject(
                 req, f"admission queue full ({self.max_queue} waiting); "
                      f"retry later")
+        if req.prefill_only and req.kv_inject is not None:
+            raise ValueError("prefill_only and kv_inject are mutually "
+                             "exclusive (one request is one half of a "
+                             "disaggregated flight)")
+        if (req.prefill_only or req.kv_inject is not None) \
+                and self.pages is None:
+            return self._reject(
+                req, "prefill/decode disaggregation needs a paged "
+                     "engine (page_size > 0): the KV handoff is "
+                     "page-granular")
+        if req.kv_inject is not None:
+            # the decode half of a migrated flight: no prefill ever
+            # runs, so the bucket check is irrelevant — validate the
+            # payload geometry and that decoding has room instead
+            pg = self.engine.page_size
+            n_pg = int(req.kv_inject.get("n_pages", 0))
+            if n_pg != -(-prompt_len // pg):
+                return self._reject(
+                    req, f"kv_inject payload carries {n_pg} pages but "
+                         f"the prompt needs {-(-prompt_len // pg)} "
+                         f"(page_size={pg})")
+            if prompt_len >= self.engine.max_seq:
+                return self._reject(
+                    req, f"adopted prompt of {prompt_len} tokens "
+                         f"leaves no room to decode "
+                         f"(max_seq={self.engine.max_seq})")
+            need = (prompt_len + 1 + pg - 1) // pg
+            if need > self.pages.capacity:
+                return self._reject(
+                    req, f"page pool exhausted: adopted prompt needs "
+                         f"{need} pages (page_size={pg}) but the pool "
+                         f"has only {self.pages.capacity}")
+            if req.deadline_at is not None or req.deadline_s is not None:
+                self._deadlines_seen = True
+            if req.deadline_at is None and req.deadline_s is not None:
+                req.deadline_at = req.t_submit + req.deadline_s
+            self._reqs[req.rid] = req
+            self.queue.append(req)
+            self.metrics.on_submit(req)
+            return req
         try:
             self.engine.bucket_for(prompt_len)
         except PromptTooLongError as e:
@@ -449,6 +566,13 @@ class Scheduler:
         req._retired = True
         self.slots[slot] = None
         self._active[slot] = False
+        # reset the slot's sampling knobs to greedy: a retired sampled
+        # request must not keep jnp.all(greedy) False forever and
+        # disable the all-greedy verify fast path for later traffic
+        # (sampling params are data — no recompile)
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
         if self.pages is not None:
             # release the slot's pages (cached prefix pages become
             # evictable, private pages free immediately) and point the
@@ -461,6 +585,11 @@ class Scheduler:
                 self.pages.release(p)
             self._slot_pages[slot] = []
             self._ptab[slot] = GARBAGE_PAGE
+        # a request retired mid-chunked-prefill (expire/cancel/shed)
+        # must not leak its deferred prefix-hash registration to the
+        # slot's next occupant — its partially-written pages were just
+        # released above, exactly the satellite-bugfix path
+        self._slot_hashes[slot] = None
 
     def _expire(self):
         """Deadline watchdog: retire any request past its wall-clock
@@ -494,7 +623,12 @@ class Scheduler:
             self.observer.event("request_expired", queued=1,
                                 **self._corr(req))
         for slot, req in enumerate(self.slots):
-            if req is None or not self._active[slot] or not expired(req):
+            # every OCCUPIED slot is expirable — including a parked
+            # prefill_only slot (active False while awaiting its
+            # first-token harvest): an expired prefill half must not
+            # go on to pay the extraction sync and migrate a dead
+            # request
+            if req is None or not expired(req):
                 continue
             self._finish_error(
                 req, f"deadline {budget(req)} exceeded after "
@@ -509,9 +643,11 @@ class Scheduler:
     @property
     def load(self) -> int:
         """Host-side occupancy signal for least-loaded routing: queued
-        plus actively decoding requests.  A plain int read — safe to
-        sample from another thread without stopping the step loop."""
-        return len(self.queue) + int(self._active.sum())
+        plus slot-occupying requests (a parked prefill_only slot
+        awaiting its handoff harvest still holds the slot).  Plain
+        reads under the GIL — safe to sample from another thread
+        without stopping the step loop."""
+        return len(self.queue) + sum(s is not None for s in self.slots)
 
     def pending_requests(self) -> list:
         """Every submitted-but-unfinished request (queued, slotted, or
@@ -578,7 +714,7 @@ class Scheduler:
             self.observer.event("engine_failure",
                                 error=self.last_engine_error)
             pending_rids = {rid for _, _, entries in self._pending
-                            for _, rid, _ in entries}
+                            for _, rid, _, _ in entries}
             try:
                 while self._pending:
                     self._harvest_one()
@@ -616,6 +752,13 @@ class Scheduler:
             if self.slots[slot] is not None or not self.queue:
                 continue
             req = self.queue[0]
+            if req.kv_inject is not None:
+                # the decode half of a disaggregated flight: adopt the
+                # migrated pages instead of prefilling (round 19)
+                if self._admit_inject(slot, req):
+                    continue
+                break                  # pool backpressure: FIFO waits
+            chunked = self.chunk_tokens is not None
             suffix, start, row = req.prompt, 0, None
             hits, fresh, hashes = [], [], []
             if self.pages is not None:
@@ -629,16 +772,28 @@ class Scheduler:
                 pg = self.engine.page_size
                 prompt = [int(t) for t in req.prompt]
                 hits = self.pages.match_prefix(prompt)
-                # the suffix's PADDED bucket must also fit max_seq —
-                # the kernel clamps an overshooting window backward,
-                # which would scatter over the cached pages themselves.
-                # Dropping trailing hits grows the suffix (monotonic:
-                # zero hits == the submit-checked full prompt), so this
-                # always terminates on a valid configuration.
-                while hits and (len(hits) * pg + self.engine.bucket_for(
-                        len(prompt) - len(hits) * pg)
-                        > self.engine.max_seq):
-                    hits.pop()
+                if chunked:
+                    # chunks write EXACT positions (no padded bucket),
+                    # so the bucket-overshoot cap does not apply; the
+                    # one constraint is never stranding a 1-token final
+                    # chunk at position max_seq-1 (a k>=1 verify window
+                    # there would clamp backward over cached pages)
+                    while hits and len(prompt) == self.engine.max_seq \
+                            and len(prompt) - len(hits) * pg < 2:
+                        hits.pop()
+                else:
+                    # the suffix's PADDED bucket must also fit max_seq —
+                    # the kernel clamps an overshooting window backward,
+                    # which would scatter over the cached pages
+                    # themselves.  Dropping trailing hits grows the
+                    # suffix (monotonic: zero hits == the submit-checked
+                    # full prompt), so this always terminates on a
+                    # valid configuration.
+                    while hits and (len(hits) * pg
+                                    + self.engine.bucket_for(
+                                        len(prompt) - len(hits) * pg)
+                                    > self.engine.max_seq):
+                        hits.pop()
                 start = len(hits) * pg
                 n_prompt_pages = -(-len(prompt) // pg)
                 need = n_prompt_pages - len(hits)
@@ -662,35 +817,55 @@ class Scheduler:
             self.queue.popleft()
             sp = req.sampling
             corr = self._corr(req)
-            try:
-                with self.observer.span("prefill", slot=slot,
-                                        suffix_len=len(suffix),
-                                        cached=start, **corr):
-                    self.arena, self.last_tokens, _ = self.engine.prefill(
-                        self.arena, self.last_tokens, slot, suffix, sp,
-                        self._next_key(), page_row=row, start=start)
-            except Exception as e:
-                # the arena was donated into the failing program: condemn
-                # the in-flight batch (and this request), keep the queue
-                self._contain(e)
-                self._finish_error(
-                    req, f"engine failure: {self.last_engine_error}",
-                    self.metrics.on_failure, "failed")
-                return
+            if not chunked:
+                # whole-prompt prefill: one blocking compiled call —
+                # every in-flight decode waits a full prefill latency
+                # behind it (the interference the chunked path removes;
+                # the counter is the before/after bench receipt)
+                self.metrics.on_prefill_block(int(self._active.sum()))
+                try:
+                    with self.observer.span("prefill", slot=slot,
+                                            suffix_len=len(suffix),
+                                            cached=start, **corr):
+                        self.arena, self.last_tokens, _ = \
+                            self.engine.prefill(
+                                self.arena, self.last_tokens, slot,
+                                suffix, sp, self._next_key(),
+                                page_row=row, start=start)
+                except Exception as e:
+                    # the arena was donated into the failing program:
+                    # condemn the in-flight batch (and this request),
+                    # keep the queue
+                    self._contain(e)
+                    self._finish_error(
+                        req, f"engine failure: {self.last_engine_error}",
+                        self.metrics.on_failure, "failed")
+                    return
             if self.pages is not None:
                 self._ptab[slot] = row
                 self._slot_pages[slot] = list(hits) + list(fresh)
-                # publish the freshly-computed FULL prompt pages under
-                # their chain hashes — the next identical prefix hits
-                # (deterministic model: same tokens at same positions
-                # => identical K/V, so first-writer-wins is sound)
-                for i in range(len(hits), len(hashes)):
-                    self.pages.register(hashes[i], int(row[i]))
+                if chunked:
+                    # registration waits for the final chunk: only then
+                    # are the prompt's pages fully written
+                    self._slot_hashes[slot] = (hashes, len(hits))
+                else:
+                    # publish the freshly-computed FULL prompt pages
+                    # under their chain hashes — the next identical
+                    # prefix hits (deterministic model: same tokens at
+                    # same positions => identical K/V, so
+                    # first-writer-wins is sound)
+                    for i in range(len(hits), len(hashes)):
+                        self.pages.register(hashes[i], int(row[i]))
                 self.metrics.on_prefix(len(hits), len(hashes), start)
             self.slots[slot] = req
             self._active[slot] = True
-            self._state[slot] = _SlotState(req.rid, len(req.prompt),
-                                           req.speculate)
+            self._state[slot] = _SlotState(
+                req.rid, start if chunked else len(req.prompt),
+                req.speculate,
+                fill_end=len(req.prompt) if chunked else None)
+            if chunked:
+                self._state[slot].fill_toks = np.asarray(req.prompt,
+                                                         np.int32)
             self._temp[slot] = sp.temperature
             self._topk[slot] = sp.top_k
             self._topp[slot] = sp.top_p
@@ -709,22 +884,103 @@ class Scheduler:
             self.observer.flow(
                 "req", corr["rid"],
                 "step" if req.origin_rid is not None else "start")
-            req._guaranteed = 1
-            self._state[slot].dispatched(0)
-            self._pending.append(
-                (self.last_tokens, None, ((slot, req.rid, 0),)))
             # prefill_tokens counts COMPUTED tokens: a prefix hit's
             # skipped tokens land in prefill_tokens_saved instead
             self.metrics.on_admit(req, slot, len(suffix))
+            if chunked:
+                # no token guaranteed yet: the first one is the final
+                # chunk's bonus sample (_dispatch_round)
+                continue
+            req._guaranteed = 1
+            self._state[slot].dispatched(0)
+            self._pending.append(
+                (self.last_tokens, None, ((slot, req.rid, 0, 0),)))
             if req._guaranteed >= self._budget(req):
                 self._retire(slot)
+            elif req.prefill_only:
+                # prefill-role replica: park the slot (no decode steps)
+                # until the first token harvests and the page payload
+                # is extracted (_harvest_one -> _handoff_out)
+                self._active[slot] = False
+
+    def _admit_inject(self, slot: int, req: Request) -> bool:
+        """Admission of a migrated (``kv_inject``) request: allocate
+        fresh pages, write the extracted prompt K/V into the pool, seed
+        the slot's cache index and last-token entry — after which the
+        slot decodes through the ordinary programs exactly as if this
+        scheduler had prefilled it (greedy token identity is the
+        disaggregation oracle).  Returns False when the pool cannot map
+        the payload yet (FIFO backpressure, like prefill admission)."""
+        payload = req.kv_inject
+        n_pg = int(payload["n_pages"])
+        if n_pg > self.pages.available:
+            return False
+        self.queue.popleft()
+        corr = self._corr(req)
+        fresh = [self.pages.alloc() for _ in range(n_pg)]
+        row = np.full(self.engine.n_ptab, GARBAGE_PAGE, np.int32)
+        row[:n_pg] = fresh
+        t0 = time.perf_counter()
+        try:
+            with self.observer.span("prefill", slot=slot, suffix_len=0,
+                                    cached=len(req.prompt), **corr):
+                self.arena, self.last_tokens = self.engine.inject_pages(
+                    self.arena, self.last_tokens, payload["data"],
+                    fresh, slot, len(req.prompt),
+                    int(payload["first_token"]))
+        except Exception as e:
+            self._contain(e)
+            self._finish_error(
+                req, f"engine failure: {self.last_engine_error}",
+                self.metrics.on_failure, "failed")
+            return True
+        self._ptab[slot] = row
+        self._slot_pages[slot] = list(fresh)
+        # re-register the migrated FULL prompt pages under their chain
+        # hashes: the target's prefix cache serves later identical
+        # prompts locally (first-writer-wins, exactly as at prefill —
+        # the satellite's "re-registered in the target allocator")
+        if self.pages.prefix_cache:
+            prompt = [int(t) for t in req.prompt]
+            for h, p in zip(self.pages.page_hashes(prompt), fresh):
+                self.pages.register(h, int(p))
+        self.metrics.on_kv_handoff(n_pg, time.perf_counter() - t0)
+        sp = req.sampling
+        self.slots[slot] = req
+        self._active[slot] = True
+        self._state[slot] = _SlotState(req.rid, len(req.prompt),
+                                       req.speculate)
+        self._temp[slot] = sp.temperature
+        self._topk[slot] = sp.top_k
+        self._topp[slot] = sp.top_p
+        req.t_admit = time.perf_counter()
+        req.admit_step = self.step_count
+        self.observer.event("kv_handoff", side="inject", pages=n_pg,
+                            **corr)
+        self.observer.event("request_admitted", slot=slot,
+                            step=self.step_count,
+                            prompt_len=len(req.prompt),
+                            cached=len(req.prompt),
+                            lineage=req.lineage, **corr)
+        self.observer.flow(
+            "req", corr["rid"],
+            "step" if req.origin_rid is not None else "start")
+        # the first token was delivered by the prefill half (seeded in
+        # req.tokens by the Router); this slot owes the remainder
+        req._guaranteed = max(1, req._guaranteed)
+        self.metrics.on_admit(req, slot, 0)
+        if req._guaranteed >= self._budget(req):
+            self._retire(slot)
+        return True
 
     # ---- paged growth -------------------------------------------------
 
-    def _grow_pages(self, lens):
-        """Map pages covering every active slot's worst-case write
+    def _grow_pages(self, step_act, lens):
+        """Map pages covering every STEPPED slot's worst-case write
         window ``[0, pos_hi + draft_len + 1)`` before dispatch
-        (``lens`` is the per-slot draft length of the upcoming verify
+        (``step_act`` is this round's dispatch mask — decoding slots
+        plus the prefilling slots that drew a chunk; ``lens`` is the
+        per-slot draft/chunk width minus one of the upcoming verify
         step, or None for a plain decode step).  Growth is host
         arithmetic over the same worst-case indices the overflow
         settling already tracks — no device reads, no new programs (the
@@ -736,7 +992,7 @@ class Scheduler:
         capacity signal is the error string, not a stall."""
         pg = self.engine.page_size
         for slot, req in enumerate(self.slots):
-            if req is None or not self._active[slot]:
+            if req is None or not step_act[slot]:
                 continue
             st = self._state[slot]
             width = 1 + (int(lens[slot]) if lens is not None else 0)
@@ -768,67 +1024,54 @@ class Scheduler:
 
     # ---- drafting -----------------------------------------------------
 
-    def _make_drafts(self):
-        """Choose this step's draft width and per-slot draft tokens.
-
-        Returns ``(k_prog, drafts [B, k_prog], draft_lens [B])`` with
-        ``k_prog == 0`` meaning "plain decode step".  ``k_prog`` is the
-        power-of-two bucket of the largest per-slot adaptive k, clamped
-        so every active slot has room for the full k_prog+1 write window
-        (``pos_hi + k_prog < max_seq``) — one compiled verify program
-        per bucket, shared by mixed spec/non-spec traffic.
-
-        Drafting under lag: the device is up to ``gap`` tokens ahead of
-        the harvested truth, so the source is asked for ``gap + k``
-        tokens continuing the TRUTH and the first ``gap`` (its guess of
-        the in-flight tokens, assuming all drafts accepted) are skipped.
-        A wrong guess costs one rejected window and heals at the next
-        harvest — predicting the gap fresh each step is what keeps a
-        single misprediction from poisoning every later draft.  With
-        ``harvest_lag=0`` the gap is 0 and drafting conditions on exact
-        state.
-        """
-        B = self.engine.n_slots
+    def _spec_desires(self):
+        """Per-slot speculative draft desires ``{slot: k}`` for this
+        step, over DECODING slots only (a prefilling slot has nothing
+        to speculate about yet), each already clamped to its own room,
+        budget, and adaptive k."""
         max_seq = self.engine.max_seq
         desires = {}
-        k_room = None
         for slot, req in enumerate(self.slots):
             if not self._active[slot]:
                 continue
             st = self._state[slot]
-            room = max_seq - 1 - st.pos_hi
-            k_room = room if k_room is None else min(k_room, room)
-            if not req.speculate:
+            if st.prefilling or not req.speculate:
                 continue
+            room = max_seq - 1 - st.pos_hi
             remaining = self._budget(req) - req._guaranteed
             des = min(st.k_cur, req.speculate, remaining - 1, room)
             if des > 0:
                 desires[slot] = des
-        if not desires or k_room < 1:
-            return 0, None, None
-        k_prog = 1
-        while k_prog < max(desires.values()):
-            k_prog *= 2
-        while k_prog > k_room and k_prog > 1:
-            k_prog //= 2
-        drafts = np.zeros((B, k_prog), np.int32)
-        lens = np.zeros(B, np.int32)
-        n_drafted = 0
-        for slot, des in desires.items():
-            req, st = self.slots[slot], self._state[slot]
-            want = min(des, k_prog)
-            gap = st.gap_est
-            ctx = np.asarray(list(req.prompt) + req.tokens, np.int32)
-            pred = np.asarray(self.draft.propose(ctx, gap + want),
-                              np.int32)
-            cand = pred[gap:gap + want]          # skip the in-flight gap
-            dl = int(cand.size)
-            drafts[slot, :dl] = cand
-            lens[slot] = dl
-            n_drafted += dl
-        if n_drafted == 0:
-            return 0, None, None
-        return k_prog, drafts, lens
+        return desires
+
+    def _plan_chunks(self):
+        """Choose this step's prefill chunks ``{slot: width}`` under the
+        per-step token budget (``chunk_tokens``), FIFO over the
+        prefilling slots.  The one sequencing rule: a prompt that fills
+        ``max_seq`` to the brim must never be left a 1-token final
+        chunk — a verify window there (always >= 2 positions wide)
+        would clamp backward over the prompt's own written positions —
+        so the penultimate chunk shrinks (or the final pair goes out
+        atomically, overshooting the budget by one token)."""
+        if self.chunk_tokens is None:
+            return {}
+        max_seq = self.engine.max_seq
+        plan = {}
+        budget = self.chunk_tokens
+        filling = [s for s in range(self.engine.n_slots)
+                   if self._active[s] and self._state[s] is not None
+                   and self._state[s].prefilling]
+        for slot in sorted(filling, key=lambda s: self._state[s].rid):
+            if budget < 1:
+                break
+            st = self._state[slot]
+            remaining = st.fill_end - st.fill_next
+            w = min(budget, remaining)
+            if st.fill_end == max_seq and remaining - w == 1:
+                w = remaining - 2 if remaining > 2 else 2
+            plan[slot] = w
+            budget -= w
+        return plan
 
     # ---- the decode round --------------------------------------------
 
@@ -865,6 +1108,13 @@ class Scheduler:
             with self.observer.span("harvest"):
                 while len(self._pending) > self.harvest_lag:
                     self._harvest_one()
+        elif not n_active and self._pending:
+            # nothing is decoding, so the lag buys no pipelining: a
+            # parked prefill_only slot (awaiting its first-token
+            # harvest to hand off) would otherwise sit under the lag
+            # threshold forever
+            with self.observer.span("harvest", idle=1):
+                self._harvest_one()
         if self.exporter is not None:
             # harvest boundary: the metrics this samples were already
             # settled by the lag harvest above — host counters only,
@@ -874,52 +1124,189 @@ class Scheduler:
         return n_active
 
     def _dispatch_round(self, n_active: int):
-        """The draft + decode/verify dispatch of one round (factored out
-        so step() can contain an engine failure to this batch)."""
-        t_draft = time.perf_counter()
-        with self.observer.span("draft", n_active=n_active):
-            k_prog, drafts, lens = self._make_drafts()
-        self.metrics.on_draft(time.perf_counter() - t_draft)
+        """The draft/chunk planning + decode/verify dispatch of one
+        round (factored out so step() can contain an engine failure to
+        this batch).  One compiled step serves the whole mix: decoding
+        slots ride as before (plain or speculative), prefilling slots
+        that drew a chunk this step ride the SAME verify program as
+        forced rows (round 19) — so a long prompt's admission costs
+        each decode step at most ``chunk_tokens`` of extra compute
+        instead of a whole-prompt prefill stall."""
+        B = self.engine.n_slots
+        max_seq = self.engine.max_seq
+        desires = self._spec_desires()
+        chunk_plan = self._plan_chunks()
+        # the step mask: decoding slots always; prefilling slots only
+        # when they drew a chunk (their index must not advance a step
+        # they are not part of)
+        step_act = self._active.copy()
+        for slot in range(B):
+            st = self._state[slot]
+            if st is not None and step_act[slot] and st.prefilling \
+                    and slot not in chunk_plan:
+                step_act[slot] = False
+        if not step_act.any():
+            return
+        # the room bound covers EVERY active slot, stepped or not: the
+        # dense verify scatter writes its k_prog+1 window into every
+        # row (inactive rows write garbage at their own index), and a
+        # window overflowing max_seq would CLAMP backward over a
+        # sitting-out slot's committed prompt K/V — paged engines route
+        # inactive writes to the garbage page, dense rows have no such
+        # shield, so the transformer-layer contract (pos + s_new <=
+        # max_seq for every row) is enforced fleet-wide here
+        k_room = min(max_seq - 1 - self._state[s].pos_hi
+                     for s in range(B) if self._active[s])
+        if k_room < 1 and (desires or chunk_plan):
+            # some stepped slot has room for exactly one more token (it
+            # retires on this write): no k>=1 verify window fits, so
+            # spec waits and chunks sit out one round — plain decode
+            # clears the full slot and the next round resumes
+            desires, chunk_plan = {}, {}
+            for slot in range(B):
+                st = self._state[slot]
+                if st is not None and step_act[slot] and st.prefilling:
+                    step_act[slot] = False
+            if not step_act.any():
+                return
+        k_need = max([0] + list(desires.values())
+                     + [w - 1 for w in chunk_plan.values()]
+                     + ([1] if chunk_plan else []))
+        drafts = lens = None
+        n_drafted = 0
+        if k_need > 0:
+            k_prog = 1
+            while k_prog < k_need:
+                k_prog *= 2
+            while k_prog > k_room and k_prog > 1:
+                k_prog //= 2
+            # re-cap chunks to the final program width (another slot's
+            # room may have shrunk k_prog below the planned width)
+            for slot in list(chunk_plan):
+                st = self._state[slot]
+                w = min(chunk_plan[slot], k_prog + 1)
+                remaining = st.fill_end - st.fill_next
+                if st.fill_end == max_seq and remaining - w == 1:
+                    w -= 1          # never strand a 1-token final chunk
+                if w < 1:
+                    del chunk_plan[slot]
+                    step_act[slot] = False
+                else:
+                    chunk_plan[slot] = w
+            if not step_act.any():
+                return
+            drafts = np.zeros((B, k_prog), np.int32)
+            lens = np.zeros(B, np.int32)
+            forced = np.zeros(B, bool)
+            first_tok = np.zeros(B, np.int32)
+            pos_set = np.zeros(B, np.int32)
+            t_draft = time.perf_counter()
+            with self.observer.span("draft", n_active=n_active):
+                for slot, des in desires.items():
+                    req, st = self.slots[slot], self._state[slot]
+                    want = min(des, k_prog)
+                    gap = st.gap_est
+                    ctx = np.asarray(list(req.prompt) + req.tokens,
+                                     np.int32)
+                    pred = np.asarray(
+                        self.draft.propose(ctx, gap + want), np.int32)
+                    cand = pred[gap:gap + want]   # skip in-flight gap
+                    dl = int(cand.size)
+                    drafts[slot, :dl] = cand
+                    lens[slot] = dl
+                    n_drafted += dl
+            self.metrics.on_draft(time.perf_counter() - t_draft)
+            for slot, w in chunk_plan.items():
+                st = self._state[slot]
+                toks = st.fill_toks[st.fill_next:st.fill_next + w]
+                first_tok[slot] = toks[0]
+                drafts[slot, :w - 1] = toks[1:]
+                lens[slot] = w - 1
+                forced[slot] = True
+                pos_set[slot] = st.fill_next
+            if n_drafted == 0 and not chunk_plan:
+                k_need = 0           # drafts came back empty: decode
         tables = None
         if self.pages is not None:
-            self._grow_pages(lens if k_prog else None)
-            if not self._active.any():   # every slot shed this round
+            self._grow_pages(step_act, lens if k_need > 0 else None)
+            step_act &= self._active     # growth may have shed slots
+            if not step_act.any():
                 return
             tables = self._ptab          # snapshot copied at dispatch
-        if k_prog > 0:
-            entries = tuple(
-                (slot, req.rid, int(lens[slot]))
-                for slot, req in enumerate(self.slots)
-                if self._active[slot])
+        if k_need > 0:
+            entries = []
+            for slot in range(B):
+                if not step_act[slot]:
+                    continue
+                req = self.slots[slot]
+                if slot in chunk_plan:
+                    st = self._state[slot]
+                    w = chunk_plan[slot]
+                    final = st.fill_next + w == st.fill_end
+                    # kind 1 = intermediate chunk (nothing delivered),
+                    # kind 2 = final chunk (deliver the bonus = the
+                    # request's first token); dl rides as 0 so the
+                    # harvest never counts prompt truth as speculation
+                    entries.append((slot, req.rid, 0, 2 if final else 1))
+                else:
+                    entries.append((slot, req.rid, int(lens[slot]), 0))
+            entries = tuple(entries)
             with self.observer.span("verify", n_active=n_active,
                                     k=k_prog):
                 (self.arena, self.last_tokens, window,
                  counts) = self.engine.verify(
                     self.arena, self.last_tokens, drafts, lens,
-                    self._active, self._next_key(), self._temp,
-                    self._topk, self._topp, page_tables=tables)
+                    step_act, self._next_key(), self._temp,
+                    self._topk, self._topp, page_tables=tables,
+                    forced=forced, first_tok=first_tok,
+                    pos_set=pos_set)
             self._pending.append((window, counts, entries))
-            self.metrics.on_verify(k_prog)
-            for slot, rid, dl in entries:
-                self._state[slot].dispatched(dl)
+            if n_drafted:
+                self.metrics.on_verify(k_prog)
+            for slot, rid, dl, kind in entries:
+                st = self._state[slot]
+                if kind == 0:
+                    st.dispatched(dl)
+                    continue
+                w = chunk_plan[slot]
+                st.dispatched(w - 1, kind)   # worst-case index += w;
+                st.fill_next += w            # output gap += 0 or 1
+                self.metrics.on_chunk(w)
+                if kind == 2 and self.pages is not None \
+                        and self._slot_hashes[slot] is not None:
+                    # prompt fully dispatched: publish its pages under
+                    # their chain hashes now (single device stream —
+                    # any later prefix-hit attend is ordered after
+                    # these writes)
+                    hashes, n_hits = self._slot_hashes[slot]
+                    row = self._ptab[slot]
+                    for i in range(n_hits, len(hashes)):
+                        self.pages.register(hashes[i], int(row[i]))
+                    self._slot_hashes[slot] = None
         else:
             entries = tuple(
-                (slot, req.rid, 0)
+                (slot, req.rid, 0, 0)
                 for slot, req in enumerate(self.slots)
-                if self._active[slot])
+                if step_act[slot])
             with self.observer.span("dispatch", n_active=n_active):
                 self.arena, self.last_tokens, _ = self.engine.decode(
-                    self.arena, self.last_tokens, self._active,
+                    self.arena, self.last_tokens, step_act,
                     self._next_key(), self._temp, self._topk,
                     self._topp, page_tables=tables)
             self._pending.append((self.last_tokens, None, entries))
-            for slot, rid, _ in entries:
+            for slot, rid, _, _ in entries:
                 self._state[slot].dispatched(0)
-        for slot, rid, _ in entries:
+        for slot, rid, dl, kind in entries:
+            if kind == 1:
+                continue             # no token guaranteed by a chunk
             req = self.slots[slot]
             req._guaranteed += 1
             if req._guaranteed >= self._budget(req):
                 self._retire(slot)
+            elif kind == 2 and req.prefill_only:
+                # prefill-role replica: park until the first token
+                # harvests and the page payload is extracted
+                self._active[slot] = False
 
     # ---- harvest ------------------------------------------------------
 
@@ -928,10 +1315,21 @@ class Scheduler:
         arr = np.asarray(window)  # blocks only until THIS (lagged) step
         cnt = np.asarray(counts) if counts is not None else None
         now = time.perf_counter()
-        for slot, rid, dl in entries:
+        for slot, rid, dl, kind in entries:
             req = self._reqs[rid]
             n_em = int(cnt[slot]) if cnt is not None else 1
-            toks = arr[slot, :n_em] if arr.ndim == 2 else arr[slot:slot+1]
+            if kind == 1:
+                # intermediate prefill chunk: the window is prompt echo
+                # plus a throwaway bonus prediction — nothing delivered
+                toks = arr[slot, :0]
+            elif kind == 2:
+                # final prefill chunk: deliver ONLY the bonus sample —
+                # the request's first generated token (the prompt echo
+                # before it committed to cache, not to output)
+                toks = arr[slot, n_em - 1:n_em]
+            else:
+                toks = (arr[slot, :n_em] if arr.ndim == 2
+                        else arr[slot:slot + 1])
             st = self._state[slot]
             if st is not None and st.rid == rid:
                 st.settle(dl, n_em)
@@ -969,8 +1367,47 @@ class Scheduler:
             # (the request's very first token is the prefill's)
             self.metrics.on_harvest_tokens(
                 delivered - (1 if first_window and delivered else 0))
+            if req.prefill_only and not req.done and req.tokens:
+                # prefill-role completion: first token known, more
+                # generation owed — export the page payload for the
+                # decode half of the flight (round 19)
+                self._handoff_out(slot, req)
             if req.done and self.slots[slot] is req:
                 self._retire(slot)
+
+    def _handoff_out(self, slot: int, req: Request):
+        """Finish a ``prefill_only`` request by exporting its prompt's
+        K/V pages to host (the ONE deliberate sync of the handoff path
+        — its cost is the ``kv_handoff_s`` metric) and attaching the
+        payload a decode replica's ``kv_inject`` admission adopts.  The
+        slot's pages are released only after extraction (the caller's
+        retire), so a mid-handoff expiry can never free them early."""
+        pg = self.engine.page_size
+        n_pg = -(-len(req.prompt) // pg)
+        pages = self._slot_pages[slot][:n_pg]
+        t0 = time.perf_counter()
+        data = self.engine.extract_pages(self.arena, pages)
+        dt = time.perf_counter() - t0
+        req.kv_handoff = {
+            "prompt": [int(t) for t in req.prompt],
+            "first_token": int(req.tokens[0]),
+            "n_pages": n_pg,
+            "data": data,
+            "t_first": req.t_first,
+        }
+        self.metrics.on_kv_handoff(n_pg, dt)
+        corr = self._corr(req)
+        self.observer.event("kv_handoff", side="extract", pages=n_pg,
+                            **corr)
+        req.done = True
+        req.t_done = time.perf_counter()
+        self.finished.append(req)
+        self.metrics.on_finish(req)
+        self.observer.event("request_finished", tokens=len(req.tokens),
+                            eos=0, **corr)
+        self.observer.flow(
+            "req", corr["rid"],
+            "step" if req.origin_rid is not None else "end")
 
     def drain(self):
         """Harvest everything still in flight (the boundary sync)."""

@@ -75,6 +75,14 @@ def main():
     flag(parser, "--slo-availability", type=float, default=0.0,
          help="SLO: availability floor, e.g. 0.999 (0 = off); bad = "
               "failed + expired over a rolling window")
+    flag(parser, "--disagg", action="store_true",
+         help="prefill/decode disaggregation: replica 0 serves only "
+              "prompt prefills (chunked), the rest only decode — "
+              "completed prefills migrate via page-granular KV handoff "
+              "(forces a paged engine)")
+    flag(parser, "--chunk-tokens", type=int, default=0,
+         help="chunked prefill on every replica: per-step prompt token "
+              "budget (0 = whole-prompt; implied 16 under --disagg)")
     flag(parser, "--seed", type=int, default=0)
     args = parser.parse_args()
     bootstrap(args)
@@ -85,8 +93,16 @@ def main():
     import flax.linen as nn
     params = nn.unbox(model.init(jax.random.PRNGKey(args.seed),
                                  jnp.zeros((1, 8), jnp.int32))["params"])
+    roles = None
+    if args.disagg:
+        if args.n_replicas < 2:
+            parser.error("--disagg needs >= 2 replicas")
+        roles = ["prefill"] + ["decode"] * (args.n_replicas - 1)
+        if not args.chunk_tokens:
+            args.chunk_tokens = 16
     engine = InferenceEngine(model, params, n_slots=args.n_slots,
-                             buckets=(64,))
+                             buckets=(64,),
+                             page_size=16 if args.disagg else 0)
 
     plan = None
     if args.kill_replica_after >= 0:
@@ -128,8 +144,11 @@ def main():
                 retry_budget=args.retry_budget,
                 hedge_after_s=args.hedge_after or None,
                 watchdog_s=args.watchdog, observer=observer,
-                exporter=exporter, slos=slos,
-                sched_kwargs={"harvest_lag": 4}) as router:
+                exporter=exporter, slos=slos, roles=roles,
+                sched_kwargs={
+                    "harvest_lag": 4,
+                    "chunk_tokens": args.chunk_tokens or None,
+                }) as router:
         for r in reqs:
             router.submit(r)
         if args.rolling_restart:
@@ -160,6 +179,10 @@ def main():
           f"{s['fleet_evictions']}  failovers {s['fleet_failovers']}  "
           f"restarts {s['fleet_restarts']}  hedges "
           f"{s['fleet_hedges']} (won {s['fleet_hedges_won']})")
+    if roles is not None:
+        print(f"  disaggregation ({'/'.join(roles)}): migrations "
+              f"{s['fleet_migrations']}  kv pages moved "
+              f"{s['fleet_kv_handoff_pages']}")
     for ev in evicts:
         lat = (f"{ev['detect_latency_s'] * 1e3:.1f}ms after worker "
                f"death" if ev["detect_latency_s"] is not None

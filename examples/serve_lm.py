@@ -67,6 +67,10 @@ def main():
          help="synthetic traffic: give every request this many common "
               "leading tokens (a system prompt) so the prefix cache "
               "has something to hit")
+    flag(parser, "--chunk-tokens", type=int, default=0,
+         help="chunked prefill: per-step prompt token budget (0 = "
+              "whole-prompt prefill); long admissions stop stalling "
+              "in-flight decodes — greedy output stays token-identical")
     flag(parser, "--quantize", default="none",
          choices=["none", "w8", "w8kv8"],
          help="int8 serving (dtdl_tpu/quant): w8 = weight-only int8 "
@@ -113,7 +117,8 @@ def main():
         draft = ModelDraft(dm, dp, warmup=args.speculate)
     sched = Scheduler(engine, seed=args.seed,
                       harvest_lag=args.harvest_lag, observer=obs,
-                      draft=draft, prefix_cache=args.prefix_cache)
+                      draft=draft, prefix_cache=args.prefix_cache,
+                      chunk_tokens=args.chunk_tokens or None)
     sp = SampleParams(temperature=args.temperature, top_k=args.top_k,
                       top_p=args.top_p)
 

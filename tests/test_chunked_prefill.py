@@ -1,0 +1,283 @@
+"""Chunked prefill (round 19): token identity, budget receipts, and the
+page-granular KV handoff the disaggregated fleet rides.
+
+The contracts, on the tiny f32 dense config of tests/test_serve.py plus
+one paged engine (both watched by a RecompileSentinel at policy='raise'
+for the whole module — chunk widths, chunk/decode mixes and handoffs
+must all be DATA on the existing program families):
+
+* **token identity** — chunked prefill produces, per request, EXACTLY
+  the tokens whole-prompt prefill produces: mixed traffic, mid-flight
+  admission, speculative decoding on, prefix-cache hits (suffix chunks
+  start at the cached boundary), and a prompt filling max_seq to the
+  brim;
+* **interference receipts** — whole-prompt prefill charges
+  ``decode_steps_delayed_by_prefill`` for every decode slot it stalls;
+  the chunked path charges zero and meters ``prefill_chunks`` /
+  ``chunk_tokens`` instead;
+* **handoff** — a ``prefill_only`` request finishes with a page payload
+  that, injected into a second scheduler, decodes token-identically to
+  an undisaggregated run (the fleet-level twin lives in
+  tests/test_fleet.py), and the payload's pages re-register in the
+  target's prefix cache;
+* **mid-chunk death** (the round-19 guarded bugfix): a request expiring
+  or cancelled mid-chunked-prefill releases its partially-written pages
+  and finishes with the kind-prefixed error + correlated trace events.
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from dtdl_tpu.models.transformer import transformer_lm
+from dtdl_tpu.obs import Observer
+from dtdl_tpu.serve import (
+    InferenceEngine, NGramDraft, Request, Scheduler,
+)
+
+MAX_SEQ = 48
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def model():
+    return transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, attn_impl="dense", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return nn.unbox(model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))["params"])
+
+
+@pytest.fixture(scope="module")
+def obs():
+    return Observer(trace=True, sentinel="raise")
+
+
+@pytest.fixture(scope="module")
+def engine(model, params, obs):
+    eng = InferenceEngine(model, params, n_slots=2,
+                          buckets=(8, 16, 32, MAX_SEQ))
+    eng.observer = obs
+    return eng
+
+
+@pytest.fixture(scope="module")
+def paged_engine(model, params, obs):
+    eng = InferenceEngine(model, params, n_slots=2,
+                          buckets=(8, 16, 32, MAX_SEQ), page_size=PAGE,
+                          n_pages=3 * (MAX_SEQ // PAGE) + 1)
+    eng.observer = obs
+    return eng
+
+
+def _run(eng, prompts, n_new, chunk=None, spec=0, **kw):
+    reqs = [Request(p, n, speculate=spec)
+            for p, n in zip(prompts, n_new)]
+    sched = Scheduler(eng, harvest_lag=2, chunk_tokens=chunk,
+                      draft=NGramDraft(), **kw)
+    sched.run(reqs)
+    assert all(r.done and r.error is None for r in reqs), \
+        [(r.rid, r.error) for r in reqs]
+    return [r.tokens for r in reqs], sched
+
+
+def test_chunked_token_identical_mixed_traffic(engine):
+    """THE chunked pin, dense arena: mixed-length prompts with
+    mid-flight admission through 2 slots, identical across whole-prompt
+    and chunk widths 1/3/8 — and the module sentinel proves every width
+    reuses the same pow2 verify buckets (chunk width is data)."""
+    gen = np.random.default_rng(1)
+    prompts = [gen.integers(0, 64, n).tolist()
+               for n in (3, 14, 29, 5, 7)]
+    n_new = (6, 4, 8, 3, 5)
+    ref, sref = _run(engine, prompts, n_new, chunk=None)
+    assert sref.metrics.summary()["decode_steps_delayed_by_prefill"] > 0
+    for chunk in (1, 3, 8):
+        got, sc = _run(engine, prompts, n_new, chunk=chunk)
+        assert got == ref, f"chunk_tokens={chunk} diverged"
+        m = sc.metrics.summary()
+        assert m["decode_steps_delayed_by_prefill"] == 0
+        # every prompt token entered through a chunk, exactly once
+        assert m["chunk_tokens"] == sum(len(p) for p in prompts)
+        assert m["prefill_chunks"] >= len(prompts)
+
+
+def test_chunked_spec_and_prefix_hits_identical(paged_engine):
+    """Chunked + paged + speculative + prefix cache: suffix chunks
+    start at the cached boundary (tokens_saved exact), spec slots share
+    the same verify step as prefill chunks, tokens identical to the
+    whole-prompt path."""
+    gen = np.random.default_rng(2)
+    shared = gen.integers(0, 64, 3 * PAGE).tolist()   # 3 full pages
+    p0 = shared + gen.integers(0, 64, 5).tolist()
+    p1 = shared + gen.integers(0, 64, 9).tolist()
+    ref0, _ = _run(paged_engine, [p0], [8], chunk=None)
+    ref1, _ = _run(paged_engine, [p1], [6], chunk=None)
+
+    sched = Scheduler(paged_engine, harvest_lag=2, chunk_tokens=5,
+                      draft=NGramDraft())
+    r0 = Request(p0, 8, speculate=4)
+    sched.run([r0])
+    r1 = Request(p1, 6, speculate=4)
+    sched.run([r1])
+    assert r0.tokens == ref0[0] and r1.tokens == ref1[0]
+    m = sched.metrics.summary()
+    # r1 hit r0's 3 shared pages (registered at r0's FINAL chunk) and
+    # chunked only its suffix
+    assert m["prefill_tokens_saved"] == 3 * PAGE, m
+    assert m["chunk_tokens"] == len(p0) + (len(p1) - 3 * PAGE), m
+
+
+def test_brim_prompt_and_single_token_budget(engine, paged_engine):
+    """A prompt filling max_seq to the brim decodes its single budgeted
+    token identically chunked and unchunked (the never-strand-a-1-token
+    -final-chunk rule), dense and paged."""
+    gen = np.random.default_rng(3)
+    long = gen.integers(0, 64, MAX_SEQ).tolist()
+    for eng in (engine, paged_engine):
+        ref, _ = _run(eng, [long], [3], chunk=None)
+        for chunk in (1, 5):
+            got, _ = _run(eng, [long], [3], chunk=chunk)
+            assert got == ref and len(got[0]) == 1, (chunk, got, ref)
+
+
+def test_expire_and_cancel_mid_chunked_prefill_release_pages(
+        model, params, obs):
+    """The guarded bugfix: a request dying mid-chunked-prefill (expire
+    or cancel) releases its partially-written pages, finishes with the
+    kind-prefixed error, and leaves correlated trace events — and the
+    slot's next occupant serves correctly over the recycled pages."""
+    eng = InferenceEngine(model, params, n_slots=1, buckets=(8, 16, 32),
+                          page_size=PAGE, n_pages=MAX_SEQ // PAGE + 1)
+    eng.observer = obs
+    gen = np.random.default_rng(4)
+    prompt = gen.integers(0, 64, 30).tolist()
+
+    # expire MID-FILL: admit + dispatch chunks under a generous
+    # deadline, then pull the deadline into the past — the next step's
+    # watchdog retires the slot with its prompt only partially written
+    import time
+    sched = Scheduler(eng, harvest_lag=2, chunk_tokens=3, observer=obs)
+    victim = Request(prompt, 8, deadline_s=60.0)
+    sched.submit(victim)
+    sched.step()
+    sched.step()                       # chunks in flight, prompt partial
+    assert not victim.done and sched.pages.pages_in_use > 0
+    victim.deadline_at = time.perf_counter() - 1.0
+    sched.step()
+    assert victim.done and victim.error.startswith("expired:"), victim
+    assert len(victim.tokens) == 0     # died before its first token
+    assert sched.pages.pages_in_use == 0, "pages leaked on expiry"
+    sched.drain()                      # in-flight chunk windows drop
+    tl = obs.request_timeline(victim.rid)
+    assert any(e.get("name") == "request_expired" for e in tl), tl
+
+    # cancel mid-fill: admit, dispatch a chunk, cancel, pages released
+    sched2 = Scheduler(eng, harvest_lag=4, chunk_tokens=3, observer=obs)
+    victim2 = Request(prompt, 8)
+    sched2.submit(victim2)
+    sched2.step()                      # admit + first chunk in flight
+    assert sched2.pages.pages_in_use > 0
+    assert sched2.cancel(victim2.rid)
+    assert victim2.done and victim2.error.startswith("aborted:")
+    assert sched2.pages.pages_in_use == 0, "pages leaked on cancel"
+    tl2 = obs.request_timeline(victim2.rid)
+    assert any(e.get("name") == "request_cancelled" for e in tl2), tl2
+    # the recycled pool serves the next request token-identically
+    ref, _ = _run(eng, [prompt], [4], chunk=None)
+    got, _ = _run(eng, [prompt], [4], chunk=3)
+    assert got == ref
+
+
+def test_prefill_only_handoff_roundtrip(paged_engine):
+    """Scheduler-level disaggregation oracle: prefill_only on one
+    scheduler -> page payload -> kv_inject into a second scheduler on
+    the same engine == the undisaggregated tokens, with handoff
+    receipts on both sides and the payload's pages re-registered in the
+    target's prefix cache."""
+    gen = np.random.default_rng(5)
+    prompt = gen.integers(0, 64, 11).tolist()
+    ref, _ = _run(paged_engine, [prompt], [7], chunk=None)
+
+    src = Scheduler(paged_engine, harvest_lag=2, chunk_tokens=4)
+    pre = Request(prompt, 7, prefill_only=True)
+    src.run([pre])
+    assert pre.done and pre.error is None
+    assert pre.kv_handoff is not None
+    assert pre.tokens == ref[0][:1]    # exactly the first token
+    ms = src.metrics.summary()
+    assert ms["kv_handoff_pages"] == -(-len(prompt) // PAGE)
+    assert ms["kv_handoff_s"] > 0
+
+    dst = Scheduler(paged_engine, harvest_lag=2)
+    dec = Request(prompt, 7, kv_inject=pre.kv_handoff)
+    dec.tokens = [pre.kv_handoff["first_token"]]
+    dst.run([dec])
+    assert dec.done and dec.error is None
+    assert dec.tokens == ref[0], (dec.tokens, ref[0])
+    md = dst.metrics.summary()
+    assert md["kv_handoff_pages"] == ms["kv_handoff_pages"]
+    # re-registration: the same prompt now prefix-hits on the TARGET
+    again = Request(prompt, 7)
+    dst.run([again])
+    assert again.tokens == ref[0]
+    assert dst.metrics.summary()["prefill_tokens_saved"] \
+        == (len(prompt) - 1) // PAGE * PAGE
+
+
+def test_handoff_requires_paged_and_validates(engine, paged_engine):
+    """Named rejections: disaggregation on a dense engine, a payload of
+    the wrong page count, and an adopted prompt with no decode room all
+    come back as kind-prefixed request errors, not crashes."""
+    r = Scheduler(engine).submit(Request([1, 2, 3], 4,
+                                         prefill_only=True))
+    assert r.done and r.error.startswith("rejected:") \
+        and "paged" in r.error
+    r2 = Scheduler(engine).submit(
+        Request([1, 2, 3], 4, kv_inject={"n_pages": 1, "data": {},
+                                         "first_token": 0}))
+    assert r2.done and r2.error.startswith("rejected:")
+    r3 = Scheduler(paged_engine).submit(
+        Request([1, 2, 3], 4, kv_inject={"n_pages": 7, "data": {},
+                                         "first_token": 0}))
+    assert r3.done and r3.error.startswith("rejected:") \
+        and "pages" in r3.error
+
+
+def test_chunked_compile_receipts_zero_recompiles(engine, paged_engine,
+                                                  obs):
+    """Cumulative program-count contract over every test above: chunk
+    widths bucket into the existing pow2 verify family (no fourth
+    family), the handoff pair compiled at most once each, and the
+    module-wide policy='raise' sentinel saw zero genuine retraces."""
+    for eng in (engine, paged_engine):
+        stats = eng.compile_stats()
+        assert stats["decode"] == 1, stats
+        assert all(n == 1 for n in stats["verify"].values()), stats
+        assert all(n == 1 for n in stats["prefill"].values()), stats
+        assert set(stats["handoff"]) == {"extract", "inject"}
+        assert all(v in (0, 1) for v in stats["handoff"].values())
+    assert obs.sentinel.summary()["recompile_events"] == 0
+
+
+def test_slotstate_gap_excludes_chunk_echo():
+    """In-flight prefill chunks advance the CACHE index (pos_hi) but
+    not the request's OUTPUT stream (gap_est): an intermediate chunk
+    contributes 0 and the final chunk exactly its bonus token —
+    otherwise the first post-prefill draft windows would skip a whole
+    chunk of the proposal and reject guaranteed."""
+    from dtdl_tpu.serve.scheduler import _SlotState
+
+    st = _SlotState(1, 0, 4, fill_end=16)
+    st.acc_ema = 1.0
+    st.dispatched(7, 1)       # intermediate chunk of 8 tokens
+    st.dispatched(7, 2)       # final chunk of 8 tokens (+ bonus)
+    st.dispatched(3, 0)       # a spec verify step, k=3
+    assert st.pos_hi == 8 + 8 + 4          # cache: every write window
+    assert st.gap_est == 0 + 1 + 4         # output: bonus + spec step

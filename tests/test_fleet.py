@@ -597,3 +597,103 @@ def test_fleet_soak_faults_and_rolling_restart(engine, oracle):
     assert s["fleet_accounting_ok"]
     assert s["fleet_requests_finished"] == len(reqs)
     assert router.pump_error is None
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation (round 19)
+# ---------------------------------------------------------------------------
+
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def paged_engine(model):
+    params = nn.unbox(model.init(jax.random.PRNGKey(1),
+                                 jnp.zeros((1, 4), jnp.int32))["params"])
+    return InferenceEngine(model, params, n_slots=2, buckets=(8,),
+                           page_size=PAGE,
+                           n_pages=3 * (MAX_SEQ // PAGE) + 1)
+
+
+@pytest.fixture(scope="module")
+def paged_oracle(paged_engine):
+    """Fault-free single-scheduler greedy reference on the shared paged
+    engine (warms the compiled programs, as `oracle` does)."""
+    prompts = mk_prompts(6, seed=9)
+    refs = [Request(list(p), N_NEW) for p in prompts]
+    Scheduler(paged_engine, harvest_lag=1).run(refs)
+    return prompts, [r.tokens for r in refs]
+
+
+@pytest.mark.fleet
+def test_disaggregated_fleet_token_identical(paged_engine, paged_oracle):
+    """THE disaggregation oracle: a prefill+decode role fleet (chunked
+    prefill replica, page-granular KV handoff through the Router)
+    serves every greedy request TOKEN-IDENTICAL to the single mixed
+    scheduler, with one migration per request, handoff receipts on both
+    sides, and the fleet accounting invariant intact."""
+    prompts, want = paged_oracle
+    with Router(paged_engine, roles=["prefill", "decode"],
+                **kw(sched_kwargs={"harvest_lag": 1,
+                                   "chunk_tokens": 4})) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        s = router.summary()
+    for r, toks in zip(reqs, want):
+        assert r.done and r.error is None, r
+        assert r.tokens == toks, f"{r} diverged across the handoff"
+    assert s["replica_roles"] == ["prefill", "decode"]
+    assert s["fleet_migrations"] == len(prompts)
+    assert s["fleet_kv_handoff_pages"] >= len(prompts)
+    assert s["fleet_accounting_ok"]
+    # both sides metered the migration (extract on 0, inject on 1)
+    assert all(rep["kv_handoff_pages"] > 0 for rep in s["replicas"])
+    # the prefill replica never decoded, the decode replica never ran a
+    # prefill program of its own for these prompts
+    assert s["replicas"][0]["decode_tokens"] == 0
+    assert s["replicas"][1]["prefill_tokens"] == 0
+    assert router.pump_error is None
+
+
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_disagg_decode_replica_death_reinjects_payload(paged_engine,
+                                                       paged_oracle):
+    """A decode replica dying after migrations re-dispatches its
+    flights WITH their page payloads to the surviving decode replica —
+    re-injection, not re-prefill, and still token-identical (the
+    payload is immutable host bytes held by the Router)."""
+    prompts, want = paged_oracle
+    plan = FaultPlan().at(replica_site(1, "loop"), 2)
+    with Router(paged_engine, roles=["prefill", "decode", "decode"],
+                plan=plan, auto_restart=True,
+                **kw(watchdog_s=0.15,
+                     sched_kwargs={"harvest_lag": 1,
+                                   "chunk_tokens": 4})) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        s = router.summary()
+    for r, toks in zip(reqs, want):
+        assert r.done and r.error is None, r
+        assert r.tokens == toks, f"{r} diverged after decode failover"
+    assert s["fleet_evictions"] == 1
+    assert s["fleet_migrations"] == len(prompts)
+    assert s["fleet_accounting_ok"]
+    assert router.pump_error is None
+
+
+@pytest.mark.fleet
+def test_role_fleet_requires_paged_decode_capable(engine, paged_engine):
+    """Role validation: any replica a migrated flight can land on
+    (decode OR mixed, when a prefill role exists) must be paged — a
+    dense mixed replica would deterministically reject kv_inject
+    attempts as terminal user failures after validation passed."""
+    with pytest.raises(ValueError, match="page_size"):
+        Router([paged_engine, engine], roles=["prefill", "mixed"],
+               warmup=False)
+    # a decode replica with no prefill replica to migrate from would
+    # idle forever — refused at construction
+    with pytest.raises(ValueError, match="prefill"):
+        Router([paged_engine, paged_engine], roles=["mixed", "decode"],
+               warmup=False)
+    # an all-mixed fleet (no migrations possible) stays dense-legal
+    r = Router([engine, engine], roles=["mixed", "mixed"], warmup=False)
+    r.shutdown(drain=False)

@@ -720,3 +720,62 @@ def test_gpipe_refused_on_legacy_jax(devices):
     got = eval_step(params, batch["tokens"], batch["targets"],
                     batch["mask"])
     assert np.isfinite(float(got["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# fused-rope attend (round 19): the PR 8 known-remaining
+# ---------------------------------------------------------------------------
+
+def test_fused_rope_attend_matches_unfused(devices):
+    """On a seq-axis-1 mesh, fuse_rope=True routes the megatron attend
+    through flash_attention(rope=..., rope_positions=...) — the rotary
+    embedding rides the kernel's tile loads instead of a per-layer
+    apply_rope HBM round-trip.  f32 forward parity vs the unfused
+    apply_rope + ring path on identical params/batch (the kernel and
+    the ring accumulate the same online softmax in f32)."""
+    import dataclasses
+
+    cfg = _cfg(n_stages=1, layers_per_stage=2, n_microbatches=2)
+    mesh = M.build_4d_mesh(devices[:1])
+    batch = _batch(cfg, B=4, S=32, seed=11)
+    params_host = jax.device_get(M.init_params(cfg, jax.random.PRNGKey(3)))
+
+    def forward(c):
+        params = M.place_params(mesh, c, params_host)
+        ev = M.make_megatron_eval_step(c, mesh)
+        b = M.shard_lm_batch(mesh, batch)
+        out = ev(params, b["tokens"], b["targets"], b["mask"])
+        return {k: float(v) for k, v in jax.device_get(out).items()}
+
+    ref = forward(cfg)                                  # auto -> unfused on CPU
+    got = forward(dataclasses.replace(cfg, fuse_rope=True))
+    assert abs(got["loss"] - ref["loss"]) <= 2e-5, (got, ref)
+    assert got["accuracy"] == ref["accuracy"]
+
+
+def test_fused_rope_refused_under_sequence_parallelism(devices):
+    """fuse_rope=True on a seq>1 mesh must fail by name at trace time:
+    ring K/V blocks rotate pre-roped, so the rotation cannot ride the
+    local kernel — silently falling back would misreport the perf
+    claim."""
+    import dataclasses
+
+    cfg = dataclasses.replace(_cfg(), fuse_rope=True)
+    mesh = M.build_4d_mesh(devices)        # factor_mesh(8): seq axis 2
+    if mesh.shape[M.SEQ] < 2:
+        pytest.skip("mesh has no sequence parallelism to refuse")
+    batch = M.shard_lm_batch(mesh, _batch(cfg, B=8, S=32))
+    params = M.place_params(mesh, cfg,
+                            M.init_params(cfg, jax.random.PRNGKey(0)))
+    step = M.make_megatron_eval_step(cfg, mesh)
+    with pytest.raises(ValueError, match="fuse_rope"):
+        step(params, batch["tokens"], batch["targets"], batch["mask"])
+
+
+def test_serve_engine_rules_requires_mesh():
+    """rules= without mesh= must fail by name, not silently serve
+    unsharded on one chip."""
+    cfg = _cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="mesh"):
+        M.serve_engine(cfg, params, rules="tp")
