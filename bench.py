@@ -410,6 +410,44 @@ def bench_robustness(steps: int = 48, batch_size: int = 256,
             **guard.summary()}
 
 
+def bench_audit() -> dict:
+    """Program-shape receipt (ISSUE 15): the pinned-program audit as a
+    bench row, so the trajectory files capture drift the way they
+    capture throughput.  Per program: collective counts + bytes (jaxpr
+    AND compiled HLO), host transfers/callbacks, and donated bytes —
+    plus the named drift list against the checked-in baseline
+    (dtdl_tpu/analysis/baselines.json; empty = the program shapes are
+    exactly what the last intentional rebase recorded)."""
+    from dtdl_tpu.analysis import contracts
+
+    runnable, skipped = contracts.runnable_programs()
+    reports = contracts.audit_programs(runnable)
+    drift = contracts.compare_to_baseline(reports,
+                                          contracts.load_baseline())
+    row = {"model": "audit",
+           "drift": [f.render() for f in drift],
+           "drift_findings": len(drift),
+           # geometries this process's device count cannot build (the
+           # megatron step needs 8) — audited in the test harness's
+           # forced 8-device platform instead of silently erroring here
+           "skipped": skipped}
+    for name, rep in sorted(reports.items()):
+        row[name] = {
+            "collectives_hlo": {k: v["count"] for k, v in
+                                rep["hlo_collectives"].items()},
+            "collective_bytes_hlo": sum(
+                v["bytes"] for v in rep["hlo_collectives"].values()),
+            "collectives_jaxpr": {k: v["count"] for k, v in
+                                  rep["jaxpr_collectives"].items()},
+            "host_transfers": rep["host_transfers"],
+            "callbacks": rep["callbacks"],
+            "donated_bytes": rep["donated_bytes"],
+            "donated_args": f"{rep['n_donated_args']}/"
+                            f"{rep['n_expected_donated']}",
+        }
+    return row
+
+
 def bench_kernels(head_dims=(64, 128), seqs=(4096,), iters: int = 2,
                   warmup: int = 1, vocabs=(32768, 256),
                   samp_batch: int = 8, samp_iters: int = 20) -> dict:
@@ -1609,6 +1647,10 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-robustness", action="store_true",
                    help="skip the robustness (resil step guard on vs off "
                         "steps/sec) row")
+    p.add_argument("--skip-audit", action="store_true",
+                   help="skip the program-shape audit row (pinned "
+                        "train/megatron/decode/verify collective census "
+                        "+ donated bytes vs the checked-in baseline)")
     p.add_argument("--serve-size", default=None,
                    help="LM size for the serving row (default: tiny on "
                         "CPU, base on an accelerator)")
@@ -1728,6 +1770,18 @@ def main(argv=None) -> dict:
                          "error": f"{type(e).__name__}: {e}"[:200]}
         records.append(resil_row)
         print("  " + json.dumps(resil_row), file=sys.stderr, flush=True)
+
+    audit_row = None
+    if not a.skip_audit:
+        # program-shape receipt (ISSUE 15): collective census + donated
+        # bytes of the pinned programs, with named drift vs baseline
+        try:
+            audit_row = bench_audit()
+        except Exception as e:  # the audit row must never sink the bench
+            audit_row = {"model": "audit",
+                         "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(audit_row)
+        print("  " + json.dumps(audit_row), file=sys.stderr, flush=True)
 
     kern_row = None
     if not a.skip_kernels:
@@ -1895,6 +1949,18 @@ def main(argv=None) -> dict:
             obs_pipe_row.get("slo_burn_crossings")
     if resil_row and "overhead_frac" in resil_row:
         summary["robustness_overhead_frac"] = resil_row["overhead_frac"]
+    if audit_row and "drift_findings" in audit_row:
+        # program-shape drift: 0 = the compiled hot paths still match
+        # the checked-in census baseline (collectives, donation, zero
+        # host traffic) — the ISSUE 15 regression harness
+        summary["audit_drift_findings"] = audit_row["drift_findings"]
+        summary["audit_decode_host_transfers"] = \
+            audit_row["serve_decode"]["host_transfers"]
+        summary["audit_train_donated_bytes"] = \
+            audit_row["train_step"]["donated_bytes"]
+        summary["audit_train_allreduces"] = \
+            audit_row["train_step"]["collectives_hlo"].get(
+                "all-reduce", 0)
     if kern_row and kern_row.get("attention"):
         # kernel receipt: the largest-seq head_dim-128 entry is the one
         # the roofline story hangs on; fall back to whatever ran

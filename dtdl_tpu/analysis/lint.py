@@ -1,0 +1,126 @@
+"""The repo linter: AST rules + suppression resolution over a file set.
+
+This is the static half of the audit subsystem (the dynamic half —
+jaxpr/HLO program audits — lives in jaxpr_audit.py / hlo_audit.py).  It
+parses every ``.py`` under the given paths, runs the per-file and
+cross-file rules from dtdl_tpu/analysis/rules/, resolves
+``# audit: ok[rule] reason`` suppressions, and returns the surviving
+findings.  Pure ``ast`` — nothing is imported or executed, so linting
+the whole package takes well under a second and runs inside tier-1
+(tests/test_analysis_gate.py) and as the CLI gate (scripts/audit.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import pathlib
+
+from dtdl_tpu.analysis import rules as rules_pkg
+from dtdl_tpu.analysis.findings import (Finding, apply_suppressions,
+                                        render_report, scan_suppressions)
+from dtdl_tpu.analysis.rules import ParsedModule
+
+__all__ = ["lint_paths", "rule_docs", "render_report", "Finding"]
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude"}
+
+#: rule ids reported by the PROGRAM auditors (jaxpr_audit / hlo_audit /
+#: contracts) and by the suppression machinery itself — part of the one
+#: documented catalog.  Program-audit findings are keyed by program
+#: name, not file:line: they are resolved by fixing the program or an
+#: intentional ``--rebase``, never by inline comments.
+EXTRA_RULES = {
+    "jaxpr-callback": "host callback traced into a program (a "
+                      "device->host round-trip every execution)",
+    "jaxpr-const-capture": "oversized constant captured by closure "
+                           "(defeats donation/sharding)",
+    "hlo-undonated": "expected-donated input not aliased in the "
+                     "optimized module (copied every call)",
+    "hlo-host-transfer": "compiled program talks to the host "
+                         "(callback custom-call / infeed / outfeed)",
+    "census-drift": "program collective census / donation diverged "
+                    "from the checked-in baseline",
+    "lint-syntax": "unparseable source file",
+    "suppress-no-reason": "suppression without a justification",
+    "suppress-stale": "suppression that matches no finding",
+    "suppress-unknown": "suppression naming a rule id that does not "
+                        "exist",
+}
+
+
+def rule_docs() -> dict:
+    """``{rule_id: one-line doc}`` — the full rule catalog (AST rules +
+    program-audit + meta rules; README mirrors it,
+    ``scripts/audit.py --list-rules`` prints it)."""
+    return dict(sorted({**rules_pkg.registry(), **EXTRA_RULES}.items()))
+
+
+def _iter_files(paths):
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    yield f
+
+
+def _rel(path: pathlib.Path, root) -> str:
+    try:
+        return path.resolve().relative_to(
+            pathlib.Path(root).resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _iter_sources(paths, root=None):
+    """Yield ``(repo_relative_path, pathlib.Path)`` for every unique
+    .py under ``paths`` — the file census; parsing (and syntax-error
+    handling) happens in :func:`lint_paths`."""
+    root = root or os.getcwd()
+    seen = set()
+    for f in _iter_files(paths):
+        rel = _rel(f, root)
+        if rel in seen:
+            continue
+        seen.add(rel)
+        yield rel, f
+
+
+def lint_paths(paths, *, root=None, only_rules=None) -> list[Finding]:
+    """Lint ``paths`` (files or directories); returns unsuppressed
+    findings.  ``only_rules`` restricts to a rule-id subset (prefix
+    match, like suppressions) — for tests and targeted CLI runs."""
+    findings: list[Finding] = []
+    sups = []
+    modules = []
+    for rel, f in _iter_sources(paths, root=root):
+        try:
+            source = f.read_text()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                "lint-syntax", rel, getattr(e, "lineno", 0) or 0,
+                f"unparseable: {e.__class__.__name__}: {e}"))
+            continue
+        mod = ParsedModule(path=rel, tree=tree, source=source)
+        modules.append(mod)
+        sups.extend(scan_suppressions(rel, source))
+    for mod in modules:
+        for chk in rules_pkg.file_checks():
+            findings.extend(chk(mod))
+    for chk in rules_pkg.repo_checks():
+        findings.extend(chk(modules))
+    out = apply_suppressions(findings, sups,
+                             known_rules=set(rule_docs()))
+    if only_rules is not None:
+        # post-filter: suppression resolution always runs over the full
+        # rule set (so staleness is judged against reality), then the
+        # caller's rule subset selects what to report
+        only = tuple(only_rules)
+        out = [f for f in out
+               if any(f.rule == r or f.rule.startswith(r + "-")
+                      for r in only)]
+    return out

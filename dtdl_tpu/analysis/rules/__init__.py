@@ -1,0 +1,120 @@
+"""Repo-specific lint rules over Python ASTs.
+
+Each rule module exposes ``check(module)`` (per-file) or
+``check_repo(modules)`` (cross-file) returning
+:class:`~dtdl_tpu.analysis.findings.Finding` lists.  The registry below
+is the single list the driver (dtdl_tpu/analysis/lint.py) runs and the
+``--list-rules`` catalog is generated from; rule ids live with their
+implementations.
+
+Shared configuration — which modules count as *hot paths* (the
+step/decode dispatch code where a stray host sync is a per-token stall,
+PR 1's async discipline) and which are sanctioned *drain points* — is
+here so every rule reads the same map of the repo.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+@dataclasses.dataclass
+class ParsedModule:
+    """One parsed source file handed to every rule."""
+
+    path: str          # repo-relative posix path (stable finding key)
+    tree: ast.Module
+    source: str
+
+    @property
+    def posix(self) -> str:
+        return self.path.replace("\\", "/")
+
+
+# ---------------------------------------------------------------------------
+# the hot-path map: modules whose code runs per step / per token.
+# Host-sync and trace-hygiene rules apply only here — flagging a
+# device_get in the checkpointer would be noise; flagging one in the
+# decode loop is the whole point.
+# ---------------------------------------------------------------------------
+
+HOT_PATH_PREFIXES = (
+    "dtdl_tpu/train/",
+    "dtdl_tpu/serve/",
+    "dtdl_tpu/parallel/",
+    "dtdl_tpu/models/",
+    "dtdl_tpu/ops/",
+    "dtdl_tpu/quant/",
+    "dtdl_tpu/metrics/",
+)
+
+# sanctioned drain points: whole modules whose JOB is the host<->device
+# boundary under the PR-1 discipline — the bounded metrics queue (one
+# device_get per drain, at log boundaries only).  Everything else
+# suppresses inline with a justification, so the exception is visible
+# at the call site.
+DRAIN_MODULES = (
+    "dtdl_tpu/metrics/device.py",
+)
+
+
+def is_hot(mod: ParsedModule) -> bool:
+    p = mod.posix
+    if any(d in p for d in DRAIN_MODULES):
+        return False
+    return any(h in p for h in HOT_PATH_PREFIXES)
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers every rule shares
+# ---------------------------------------------------------------------------
+
+def dotted(node) -> str:
+    """The dotted name of a Name/Attribute chain (``jax.device_get``),
+    or '' when the expression is not a plain chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def walk_with_scope(tree):
+    """Yield ``(node, enclosing_function_name)`` over the whole tree —
+    the scope is the nearest enclosing FunctionDef name ('' at module
+    level), which several rules key allowlists on."""
+    def rec(node, scope):
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = child.name
+            yield child, child_scope
+            yield from rec(child, child_scope)
+    yield tree, ""
+    yield from rec(tree, "")
+
+
+def registry():
+    """``{rule_id: one_line_doc}`` over every registered rule."""
+    from dtdl_tpu.analysis.rules import (catalogs, compat, donation,
+                                         host_sync, trace_hygiene)
+    out = {}
+    for mod in (host_sync, compat, donation, trace_hygiene, catalogs):
+        out.update(mod.RULES)
+    return out
+
+
+def file_checks():
+    from dtdl_tpu.analysis.rules import (compat, donation, host_sync,
+                                         trace_hygiene)
+    return (host_sync.check, compat.check, donation.check,
+            trace_hygiene.check)
+
+
+def repo_checks():
+    from dtdl_tpu.analysis.rules import catalogs
+    return (catalogs.check_repo,)

@@ -1,0 +1,62 @@
+"""The _compat discipline: one forward-compatible spelling per API.
+
+dtdl_tpu/_compat.py patches ``jax.shard_map`` / ``lax.pcast`` /
+``jax.typeof`` onto legacy jax at package import, so every call site
+keeps the modern spelling.  A call site that reaches around the shim —
+``from jax.experimental.shard_map import shard_map`` — works on today's
+container and silently breaks (or forks semantics: the shim pins
+``check_rep=False``) when either jax bound moves.  These rules keep the
+shim the single owner of that compatibility decision.
+
+* ``compat-shard-map`` — any import or attribute reference to
+  ``jax.experimental.shard_map`` outside _compat.py itself.
+* ``compat-maps``     — the removed ``jax.experimental.maps`` /
+  ``xmap`` namespace (predates even the legacy bound this repo shims).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dtdl_tpu.analysis.findings import Finding
+from dtdl_tpu.analysis.rules import dotted
+
+RULES = {
+    "compat-shard-map": "jax.experimental.shard_map referenced directly "
+                        "(use jax.shard_map via dtdl_tpu._compat)",
+    "compat-maps": "removed jax.experimental.maps/xmap namespace "
+                   "referenced",
+}
+
+
+def check(mod) -> list[Finding]:
+    if mod.posix.endswith("dtdl_tpu/_compat.py"):
+        return []            # the shim is the one sanctioned reference
+    out = []
+    for node in ast.walk(mod.tree):
+        ref = None
+        if isinstance(node, ast.ImportFrom):
+            ref = node.module or ""
+            if ref == "jax.experimental" and any(
+                    a.name == "shard_map" for a in node.names):
+                ref = "jax.experimental.shard_map"
+        elif isinstance(node, ast.Import):
+            hit = next((a.name for a in node.names
+                        if a.name.startswith("jax.experimental.shard_map")
+                        or a.name.startswith("jax.experimental.maps")),
+                       None)
+            ref = hit or ""
+        elif isinstance(node, ast.Attribute):
+            ref = dotted(node)
+        if not ref:
+            continue
+        if ref.startswith("jax.experimental.shard_map"):
+            out.append(Finding(
+                "compat-shard-map", mod.path, node.lineno,
+                "bypasses dtdl_tpu._compat — call jax.shard_map (the "
+                "shim owns the legacy-jax fallback + check_rep policy)"))
+        elif ref.startswith("jax.experimental.maps"):
+            out.append(Finding(
+                "compat-maps", mod.path, node.lineno,
+                f"{ref} was removed upstream"))
+    return out

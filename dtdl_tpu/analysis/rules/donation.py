@@ -1,0 +1,89 @@
+"""Donation discipline: state-threading jits must donate their state.
+
+A train/decode step threads a large state pytree (params + optimizer
+state, or the KV arena — the largest buffers in the program) through
+every call.  ``jax.jit`` without ``donate_argnums`` makes XLA allocate a
+fresh output copy per step: correctness intact, HBM footprint doubled
+and a copy inserted on the hottest path — exactly the regression that
+surfaces months later as a mystery OOM at a bigger batch.  (The
+compiled-program side — whether XLA actually aliased the donated
+buffers — is the HLO auditor's job, dtdl_tpu/analysis/hlo_audit.py;
+this rule catches the *lost annotation* before anything compiles.)
+
+``jit-donate`` flags a ``jax.jit(fn)`` call (or ``@jax.jit`` /
+``@partial(jax.jit, ...)`` decoration) with no ``donate_argnums`` /
+``donate_argnames`` when the jitted function looks like a
+state-threading step: its name (or its factory's name) contains a
+step/decode/prefill/verify/inject token.  Eval/predict programs reuse
+their params across calls — never donated, never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dtdl_tpu.analysis.findings import Finding
+from dtdl_tpu.analysis.rules import dotted, walk_with_scope
+
+RULES = {
+    "jit-donate": "state-threading jax.jit without donate_argnums "
+                  "(fresh HBM copy of the state every step)",
+}
+
+_STEP_RE = re.compile(r"(^|_)(step|decode|prefill|verify|inject|train)(_|$)")
+_FACTORY_RE = re.compile(r"^make_\w*step$|^_build_\w+$")
+_EXEMPT_RE = re.compile(r"eval|predict|extract|infer")
+
+
+def _has_donate(call: ast.Call) -> bool:
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in call.keywords)
+
+
+def _target_name(call: ast.Call) -> str:
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return ""
+
+
+def _is_jit(node) -> bool:
+    return dotted(node) in ("jax.jit", "pjit", "jax.pjit")
+
+
+def _flag(mod, lineno, fn_name, scope):
+    return Finding(
+        "jit-donate", mod.path, lineno,
+        f"jax.jit of step-like '{fn_name or scope}' without "
+        f"donate_argnums — the threaded state is copied every call")
+
+
+def check(mod) -> list[Finding]:
+    out = []
+    for node, scope in walk_with_scope(mod.tree):
+        # jax.jit(fn, ...) call form
+        if isinstance(node, ast.Call) and _is_jit(node.func):
+            if _has_donate(node):
+                continue
+            fn = _target_name(node)
+            step_like = (_STEP_RE.search(fn or "")
+                         or _FACTORY_RE.match(scope or ""))
+            exempt = _EXEMPT_RE.search(fn) or _EXEMPT_RE.search(scope)
+            if step_like and not exempt:
+                out.append(_flag(mod, node.lineno, fn, scope))
+        # decorator forms: @jax.jit / @partial(jax.jit, ...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                bare = _is_jit(dec)
+                part = (isinstance(dec, ast.Call)
+                        and dotted(dec.func) in ("partial",
+                                                 "functools.partial")
+                        and dec.args and _is_jit(dec.args[0]))
+                if not (bare or part):
+                    continue
+                if part and _has_donate(dec):
+                    continue
+                if (_STEP_RE.search(node.name)
+                        and not _EXEMPT_RE.search(node.name)):
+                    out.append(_flag(mod, dec.lineno, node.name, scope))
+    return out

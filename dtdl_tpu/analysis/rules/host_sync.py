@@ -1,0 +1,84 @@
+"""The host-sync ban: no device reads on step/decode dispatch paths.
+
+PR 1 removed every per-step host↔device round-trip from the training
+loops and PR 2's scheduler kept decode dispatch sync-free; these rules
+make that discipline machine-checked.  In a hot-path module (see
+``rules.HOT_PATH_PREFIXES``) each of the following is a finding:
+
+* ``host-sync-get``     — ``jax.device_get(...)``: a blocking transfer.
+* ``host-sync-block``   — ``.block_until_ready()``: a pure wait.
+* ``host-sync-item``    — ``.item()``: scalar read; the classic hidden
+  sync (``float(loss)`` and friends compile down to this).
+* ``host-sync-float``   — ``float(...)`` / ``int(...)`` / ``bool(...)``
+  applied directly to a ``jnp.``/``jax.`` expression.
+* ``host-sync-asarray`` — ``np.asarray(...)`` / ``np.array(...)``: on a
+  device array this is a device_get in numpy clothing.  (``jnp.asarray``
+  is host→device and dispatches asynchronously — not flagged.)
+
+Sanctioned syncs — the metrics-queue drain, the one deliberate
+device_get of the KV handoff, API-entry conversion of caller-supplied
+host data — are either drain-point modules (``rules.DRAIN_MODULES``) or
+carry an inline ``# audit: ok[...]`` with the justification, so every
+exception is visible where it happens.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dtdl_tpu.analysis.findings import Finding
+from dtdl_tpu.analysis.rules import dotted, is_hot
+
+RULES = {
+    "host-sync-get": "jax.device_get on a hot path (blocking transfer)",
+    "host-sync-block": ".block_until_ready() on a hot path",
+    "host-sync-item": ".item() scalar read on a hot path",
+    "host-sync-float": "float()/int()/bool() of a jax value on a hot "
+                       "path (hidden .item())",
+    "host-sync-asarray": "np.asarray/np.array on a hot path (device_get "
+                         "in numpy clothing)",
+}
+
+_ASARRAY = ("np.asarray", "numpy.asarray", "np.array", "numpy.array")
+_CASTS = ("float", "int", "bool")
+
+
+def _is_jax_rooted(node) -> bool:
+    """Does this expression chain root at a jax/jnp name (so a host
+    cast of it forces a device read)?"""
+    while isinstance(node, (ast.Attribute, ast.Call, ast.Subscript)):
+        node = (node.func if isinstance(node, ast.Call)
+                else node.value)
+    return isinstance(node, ast.Name) and node.id in ("jnp", "jax", "lax")
+
+
+def check(mod) -> list[Finding]:
+    if not is_hot(mod):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name == "jax.device_get":
+            out.append(Finding("host-sync-get", mod.path, node.lineno,
+                               "jax.device_get on a hot path"))
+        elif name in _ASARRAY:
+            out.append(Finding("host-sync-asarray", mod.path, node.lineno,
+                               f"{name} on a hot path"))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "block_until_ready"):
+            out.append(Finding("host-sync-block", mod.path, node.lineno,
+                               ".block_until_ready() on a hot path"))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args
+              and not node.keywords):
+            out.append(Finding("host-sync-item", mod.path, node.lineno,
+                               ".item() on a hot path"))
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in _CASTS and len(node.args) == 1
+              and _is_jax_rooted(node.args[0])):
+            out.append(Finding(
+                "host-sync-float", mod.path, node.lineno,
+                f"{node.func.id}() of a jax expression on a hot path"))
+    return out

@@ -1,0 +1,93 @@
+"""Trace hygiene: no wall clocks or host RNG inside traced functions.
+
+A ``time.time()`` or ``np.random`` call inside a function that gets
+jitted runs ONCE, at trace time, and bakes its value into the compiled
+program as a constant — every subsequent step reuses the stale
+timestamp / the same "random" draw.  It never errors; it just silently
+measures nothing and decorrelates nothing (the classic jax footgun).
+Host timing belongs outside the program; randomness inside one goes
+through ``jax.random`` keys threaded as arguments.
+
+The rule finds functions that are jit/shard_map targets in the same
+module — ``jax.jit(f)``, ``@jax.jit``, ``@partial(jax.jit, ...)``,
+``jax.shard_map(f, ...)``, and the strategy idiom
+``*.compile/compile_eval/compile_predict(f)`` — and flags, anywhere in
+their bodies (nested defs included):
+
+* ``trace-host-time`` — ``time.time/perf_counter/monotonic/
+  process_time`` and ``datetime.now``.
+* ``trace-host-rng``  — ``np.random.*`` / ``random.*`` draws.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dtdl_tpu.analysis.findings import Finding
+from dtdl_tpu.analysis.rules import dotted
+
+RULES = {
+    "trace-host-time": "host clock call inside a traced function "
+                       "(bakes a constant at trace time)",
+    "trace-host-rng": "host RNG inside a traced function (same draw "
+                      "every step; thread a jax.random key instead)",
+}
+
+_TIME = ("time.time", "time.perf_counter", "time.monotonic",
+         "time.process_time", "datetime.now", "datetime.datetime.now")
+_RNG_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+
+def _traced_names(tree) -> set[str]:
+    """Names of functions this module passes to jit/shard_map/compile."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func)
+            is_wrap = fn in ("jax.jit", "jax.shard_map", "pjit",
+                             "jax.pjit", "jax.make_jaxpr")
+            is_partial_jit = (fn in ("partial", "functools.partial")
+                              and node.args
+                              and dotted(node.args[0]) == "jax.jit")
+            is_compile = (isinstance(node.func, ast.Attribute)
+                          and node.func.attr.startswith("compile"))
+            if (is_wrap or is_partial_jit or is_compile):
+                args = node.args[1:] if is_partial_jit else node.args
+                for a in args:
+                    if isinstance(a, ast.Name):
+                        names.add(a.id)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if dotted(dec) == "jax.jit" or (
+                        isinstance(dec, ast.Call)
+                        and dotted(dec.func) in ("partial",
+                                                 "functools.partial")
+                        and dec.args
+                        and dotted(dec.args[0]) == "jax.jit"):
+                    names.add(node.name)
+    return names
+
+
+def check(mod) -> list[Finding]:
+    traced = _traced_names(mod.tree)
+    if not traced:
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in traced):
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = dotted(sub.func)
+                if name in _TIME:
+                    out.append(Finding(
+                        "trace-host-time", mod.path, sub.lineno,
+                        f"{name}() inside traced '{node.name}' is a "
+                        f"trace-time constant"))
+                elif any(name.startswith(p) for p in _RNG_PREFIXES):
+                    out.append(Finding(
+                        "trace-host-rng", mod.path, sub.lineno,
+                        f"{name}() inside traced '{node.name}' draws "
+                        f"once at trace time"))
+    return out

@@ -225,6 +225,7 @@ class Attention(nn.Module):
             # checkable HERE instead of silently clamping the write into
             # the last cache row (jitted callers must bound-check before
             # tracing — see CacheOverflowError)
+            # audit: ok[host-sync-float] eager-only overflow check — jitted callers never reach this branch
             limit = int(jnp.max(pos)) if pos.ndim else int(pos)
             if limit + s_new > max_len:
                 raise CacheOverflowError(
@@ -455,8 +456,10 @@ class Attention(nn.Module):
             # serving engine always runs this jitted and bound-checks
             # host-side before dispatch)
             live = jnp.where(jnp.asarray(active), jnp.asarray(pos), 0)
+            # audit: ok[host-sync-float] eager-only overflow check — jitted callers never reach this branch
             if int(jnp.max(live)) + s_new > max_len:
                 raise CacheOverflowError(
+                    # audit: ok[host-sync-float] eager-only overflow check — jitted callers never reach this branch
                     f"paged decode at position {int(jnp.max(live))} with "
                     f"{s_new} new token(s) exceeds max_seq={max_len}")
         # clamped positions: identity for active rows (caller contract),

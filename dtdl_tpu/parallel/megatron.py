@@ -1244,6 +1244,7 @@ def shard_lm_batch(mesh: Mesh, batch: dict) -> dict:
                         "zigzag shard_lm_batch requires the 'seq' mesh axis "
                         "to be process-local; lay 'data' over DCN instead")
         order = zigzag_order(n_sp, next(iter(batch.values())).shape[1])
+        # audit: ok[host-sync-asarray] host batch reorder before device_put — input is host data by contract
         batch = {k: np.asarray(v)[:, order] for k, v in batch.items()}
     sharding = NamedSharding(mesh, P(DATA, SEQ))
     if jax.process_count() == 1:
@@ -1399,6 +1400,7 @@ def serve_engine(cfg: MegatronConfig, params: dict, mesh: Mesh = None,
                          f"tensor-parallel serving needs the mesh the "
                          f"shards land on")
     model = to_flax_model(cfg, **overrides)
+    # audit: ok[host-sync-get] to_flax_model is the cold train->serve bridge, not a step path
     fparams = to_flax_params(cfg, jax.device_get(params))
     if mesh is not None and rules is None:
         # replicated placement (the throughput-parallel default); the

@@ -77,6 +77,69 @@ def logical_shardings(mesh: Mesh, tree, rules="tp"):
     return nn.logical_to_mesh_sharding(specs, mesh, list(rules))
 
 
+def quant_logical_shardings(mesh: Mesh, model, rules="tp"):
+    """NamedShardings for a ``quantize_params`` tree (round 20 — the
+    PR 14 known-remaining TP+quantize composition).
+
+    The quantized clone's params carry no flax logical-axis metadata
+    (``QuantDenseGeneral`` declares plain placeholders — a quantized
+    model is served, never trained), so ``logical_shardings`` cannot
+    shard them.  But the layout is fully determined by the f32 tree:
+
+    * every int8 ``kernel`` keeps its f32 twin's module path AND shape
+      (dtdl_tpu/quant/core.py), so it inherits the twin's spec verbatim
+      — column/row-parallel exactly like the weights it replaces;
+    * every ``<name>_scale`` sibling is its tensor's shape with the
+      contracted dims as keepdims 1s, so its spec is the tensor's spec
+      with every size-1 dim unsharded — a 'model'-sharded output
+      feature dim keeps its per-channel scales sharded alongside it
+      (each TP shard multiplies by exactly its own channels' scales),
+      and replicated dims stay replicated;
+    * unquantized leaves (embed, norms, router) pass through on their
+      own logical spec.
+
+    ``model`` may be the quantized or unquantized module — both clones
+    are derived here.  Returns a sharding pytree matching the
+    ``quantize_params`` output structure.
+    """
+    import functools
+
+    from dtdl_tpu.quant import SCALE_SUFFIX
+
+    tokens = jnp.zeros((1, 1), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    boxed = jax.eval_shape(
+        functools.partial(model.clone(quantize=False).init, rng),
+        tokens)["params"]
+    f_sh = logical_shardings(mesh, boxed, rules)
+    q_abs = nn.unbox(jax.eval_shape(
+        functools.partial(model.clone(quantize=True).init, rng),
+        tokens)["params"])
+
+    def scale_spec(tensor_sharding, scale_shape):
+        spec = tensor_sharding.spec
+        return NamedSharding(mesh, P(*[
+            spec[i] if i < len(spec) and scale_shape[i] != 1 else None
+            for i in range(len(scale_shape))]))
+
+    def conv(q, f):
+        out = {}
+        for name, sub in q.items():
+            base = name[:-len(SCALE_SUFFIX)]
+            if name.endswith(SCALE_SUFFIX) and base in q:
+                continue                  # emitted with its tensor
+            if isinstance(sub, dict):
+                out[name] = conv(sub, f[name])
+                continue
+            out[name] = f[name]
+            sname = f"{name}{SCALE_SUFFIX}"
+            if sname in q:
+                out[sname] = scale_spec(f[name], q[sname].shape)
+        return out
+
+    return conv(q_abs, f_sh)
+
+
 def heads_axis_size(mesh: Mesh, rules="tp") -> int:
     """Size of the mesh axis the 'heads' logical dim shards on under
     ``rules`` (1 when unsharded) — the serving engine's divisibility

@@ -66,6 +66,7 @@ class NGramDraft:
         self.min_n = min_n
 
     def propose(self, ctx, k: int) -> np.ndarray:
+        # audit: ok[host-sync-asarray] n-gram drafting is pure host work on host token lists
         ctx = np.asarray(ctx, np.int32).ravel()
         L = ctx.size
         if L < 2 or k < 1:
@@ -146,6 +147,7 @@ class ModelDraft:
 
         from dtdl_tpu.models.transformer import generate
 
+        # audit: ok[host-sync-asarray] drafting context is a host token list
         ctx = np.asarray(ctx, np.int32).ravel()
         if ctx.size < 1 or k < 1:
             return np.zeros((0,), np.int32)
@@ -157,4 +159,5 @@ class ModelDraft:
             return np.zeros((0,), np.int32)
         out = generate(self.model, self.params,
                        jnp.asarray(ctx[None, ctx.size - s0:]), kb)
+        # audit: ok[host-sync-asarray] draft-model output read — drafting is host-side by design (draft_s)
         return np.asarray(out)[0, s0:s0 + min(k, kb)].astype(np.int32)

@@ -200,16 +200,11 @@ class InferenceEngine:
         self.quantized_weights = bool(quantize_weights)
         self.kv_dtype = canon_kv_dtype(kv_dtype)
         if quantize_weights:
-            if mesh is not None:
-                # the quantized tree carries _scale siblings the flax
-                # logical metadata does not declare — sharding it needs
-                # a quant-aware rule map, a later round
-                raise ValueError(
-                    "quantize_weights does not compose with mesh= "
-                    "(tensor-parallel) serving yet; serve the f32/bf16 "
-                    "params sharded, or quantized on one chip")
             # params are the UNQUANTIZED tree the caller trained/loaded;
-            # the quantized clone declares the int8+scale schema
+            # the quantized clone declares the int8+scale schema.  On a
+            # mesh, the quant-aware rule map below (round 20) shards the
+            # int8 kernels on their f32 twins' logical axes and each
+            # _scale sibling alongside its tensor.
             params = quantize_params(model, params)
             model = model.clone(quantize=True)
         self.model = model
@@ -228,18 +223,26 @@ class InferenceEngine:
             import functools
 
             from dtdl_tpu.parallel.tensor import (heads_axis_size,
-                                                  logical_shardings)
+                                                  logical_shardings,
+                                                  quant_logical_shardings)
             tp = heads_axis_size(mesh, rules)
             if self.model.n_heads % tp:
                 raise ValueError(
                     f"n_heads={self.model.n_heads} must divide by the "
                     f"mesh's tensor-parallel axis size {tp} "
                     f"(rules={rules!r})")
-            abs_boxed = jax.eval_shape(
-                functools.partial(self.model.init,
-                                  jax.random.PRNGKey(0)),
-                jnp.zeros((1, 1), jnp.int32))["params"]
-            param_sh = logical_shardings(mesh, abs_boxed, rules)
+            if quantize_weights:
+                # the quantized tree carries no flax logical metadata;
+                # the quant rule map derives int8-kernel + scale specs
+                # from the f32 twin (parallel/tensor.py, round 20)
+                param_sh = quant_logical_shardings(mesh, self.model,
+                                                   rules)
+            else:
+                abs_boxed = jax.eval_shape(
+                    functools.partial(self.model.init,
+                                      jax.random.PRNGKey(0)),
+                    jnp.zeros((1, 1), jnp.int32))["params"]
+                param_sh = logical_shardings(mesh, abs_boxed, rules)
             self.params = jax.device_put(self.params, param_sh)
         # obs facade: when set (directly or by the Scheduler), the
         # recompile sentinel wraps each compiled program — a retrace of
@@ -624,6 +627,7 @@ class InferenceEngine:
         the program re-enters through the suffix's (smaller) bucket,
         which is exactly the prefill-FLOPs-skipped win a cache hit
         buys (see ``prefill_calls``)."""
+        # audit: ok[host-sync-asarray] admission-time conversion of the caller's host prompt list
         prompt = np.asarray(prompt, np.int32).ravel()
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -638,6 +642,7 @@ class InferenceEngine:
                 raise ValueError(f"start={start} must be a non-negative "
                                  f"multiple of page_size="
                                  f"{self.page_size}")
+            # audit: ok[host-sync-asarray] admission-time conversion of the caller's host page_row
             page_row = np.asarray(page_row, np.int32).ravel()
             if page_row.size != self.n_ptab:
                 raise ValueError(f"page_row must have {self.n_ptab} "
@@ -851,6 +856,7 @@ class InferenceEngine:
             if self.observer is not None:
                 fn = self.observer.watch(fn, "serve.kv_extract")
             self._extract_fn = fn
+        # audit: ok[host-sync-get] the ONE deliberate sync of the KV handoff (metered as kv_handoff_s)
         host = jax.device_get(self._extract_fn(arena, jnp.asarray(ids)))
         return jax.tree.map(lambda a: a[:n], host)
 
@@ -889,6 +895,7 @@ class InferenceEngine:
         ids[:n] = page_ids
 
         def pad(a):
+            # audit: ok[host-sync-asarray] pads extract_pages output — already host memory
             a = np.asarray(a)
             out = np.zeros((self.n_ptab,) + a.shape[1:], a.dtype)
             out[:n] = a

@@ -864,6 +864,7 @@ class Scheduler:
                 req.speculate,
                 fill_end=len(req.prompt) if chunked else None)
             if chunked:
+                # audit: ok[host-sync-asarray] chunked-prefill queue of the caller's host prompt list
                 self._state[slot].fill_toks = np.asarray(req.prompt,
                                                          np.int32)
             self._temp[slot] = sp.temperature
@@ -1206,8 +1207,10 @@ class Scheduler:
                     req, st = self.slots[slot], self._state[slot]
                     want = min(des, k_prog)
                     gap = st.gap_est
+                    # audit: ok[host-sync-asarray] drafting context from host prompt/token lists
                     ctx = np.asarray(list(req.prompt) + req.tokens,
                                      np.int32)
+                    # audit: ok[host-sync-asarray] host-side draft source output (draft_s meters this phase)
                     pred = np.asarray(
                         self.draft.propose(ctx, gap + want), np.int32)
                     cand = pred[gap:gap + want]   # skip in-flight gap
@@ -1312,7 +1315,9 @@ class Scheduler:
 
     def _harvest_one(self):
         window, counts, entries = self._pending.popleft()
+        # audit: ok[host-sync-asarray] the lag harvest — blocks only until the k-steps-lagged window
         arr = np.asarray(window)  # blocks only until THIS (lagged) step
+        # audit: ok[host-sync-asarray] the lag harvest — the sanctioned boundary read (counts)
         cnt = np.asarray(counts) if counts is not None else None
         now = time.perf_counter()
         for slot, rid, dl, kind in entries:

@@ -90,7 +90,9 @@ def _as_loader(data, batch_size: int = 128) -> DataLoader:
     if isinstance(data, DataLoader) or hasattr(data, "batch_size"):
         return data
     features, labels = data
+    # audit: ok[host-sync-asarray] input_fn feature/label pair is caller-supplied host data
     return DataLoader({"image": np.asarray(features),
+                       # audit: ok[host-sync-asarray] input_fn feature/label pair is caller-supplied host data
                        "label": np.asarray(labels)}, batch_size)
 
 
@@ -274,6 +276,7 @@ class Estimator:
             n = len(next(iter(batch.values())))
             padded = _pad_and_mask(batch, loader.batch_size)
             padded.pop("mask")
+            # audit: ok[host-sync] predict() yields host rows by contract — the drain point of the predict loop
             logits = np.asarray(jax.device_get(predict_step(
                 state, self.strategy.shard_batch(padded))))[:n]
             for row in logits:

@@ -174,7 +174,9 @@ class Model:
         from dtdl_tpu.obs.observer import NULL_OBSERVER
         import time as _time
         obs = observer or NULL_OBSERVER
+        # audit: ok[host-sync-asarray] fit() entry: caller-supplied host arrays
         x = np.asarray(x)
+        # audit: ok[host-sync-asarray] fit() entry: caller-supplied host arrays
         y = np.asarray(y)
         self._ensure_state(x)
         history = History()
@@ -233,7 +235,9 @@ class Model:
     def evaluate(self, x, y, batch_size: int = 32, verbose: int = 1) -> dict:
         """Exact full-dataset metrics (ragged tails masked, never dropped)."""
         from dtdl_tpu.train.loop import evaluate as _evaluate
+        # audit: ok[host-sync-asarray] evaluate() entry: caller-supplied host arrays
         x = np.asarray(x)
+        # audit: ok[host-sync-asarray] evaluate() entry: caller-supplied host arrays
         y = np.asarray(y)
         self._ensure_state(x)
         loader = self._loader(x, y, batch_size, shuffle=False, seed=0,
@@ -250,6 +254,7 @@ class Model:
         Multi-process: each host computes its stripe; results are
         all-gathered so every host returns the full, ordered output.
         """
+        # audit: ok[host-sync-asarray] predict() entry: caller-supplied host arrays
         x = np.asarray(x)
         self._ensure_state(x)
         n = len(x)
@@ -277,11 +282,17 @@ class Model:
                 {"image": jnp.asarray(xb),
                  "label": jnp.zeros((len(xb),), jnp.int32)})
             probs = self._predict_step(self.state, batch)
-            probs = np.concatenate(
-                [np.asarray(s.data) for s in sorted(
-                    probs.addressable_shards, key=lambda s: s.index[0].start
-                    if s.index and s.index[0].start is not None else 0)]) \
-                if nproc > 1 else np.asarray(probs)
+            if nproc > 1:
+                probs = np.concatenate(
+                    # audit: ok[host-sync-asarray] multi-host predict gathers its stripe to host by contract
+                    [np.asarray(s.data) for s in sorted(
+                        probs.addressable_shards,
+                        key=lambda s: s.index[0].start
+                        if s.index and s.index[0].start is not None
+                        else 0)])
+            else:
+                # audit: ok[host-sync-asarray] predict() returns host arrays by contract — the output drain
+                probs = np.asarray(probs)
             outs.append(probs[:per_host_bs - pad] if pad else probs)
         local_out = np.concatenate(outs)
         if nproc > 1:
@@ -299,6 +310,7 @@ class Model:
         if self.state is None:
             raise ValueError("call fit/evaluate once (or compile with "
                              "example_input) before load_weights")
+        # audit: ok[host-sync-get] weights IO — checkpoint restore is a cold path
         params = load_weights(path, jax.device_get(self.state.params))
         self.state = self.state.replace(
             params=self.strategy.replicate(params))
@@ -308,6 +320,7 @@ class Model:
         ckpt = Checkpointer(directory)
         if self.state is None:
             raise ValueError("state not initialized yet")
+        # audit: ok[host-sync-get] weights IO — checkpoint restore is a cold path
         params, epoch = ckpt.latest_weights(jax.device_get(self.state.params))
         if params is None:
             return False

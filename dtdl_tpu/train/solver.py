@@ -161,6 +161,7 @@ class Solver:
         self.tx = make_optimizer(sp)
         self.state = self.strategy.replicate(init_state(
             self.net, jax.random.PRNGKey(seed),
+            # audit: ok[host-sync-asarray] shape probe of one host sample at solver build time
             jnp.zeros((1,) + np.asarray(sample["image"]).shape[1:]),
             self.tx))
         self.train_step = make_train_step(self.strategy, seed=seed)

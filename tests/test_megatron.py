@@ -547,6 +547,7 @@ def test_4d_checkpoint_resume_equivalence(devices, tmp_path):
                                    **CKPT_PARAM_TOL)
 
 
+@pytest.mark.slow   # 21s compile — the tier-1 budget-discipline cut
 def test_moe_top2_routed_matches_dense(devices):
     """GShard-style top-2: with capacity that can never drop, the routed
     all-to-all dispatch and the dense one-hot dispatch compute the same

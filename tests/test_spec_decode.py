@@ -107,6 +107,7 @@ def test_greedy_spec_token_identical_mixed_traffic(engine):
     assert s["decode_tokens"] == sum(len(t) for t in ref) - len(ref)
 
 
+@pytest.mark.slow   # 19s — the tier-1 budget-discipline cut
 def test_spec_lossless_under_garbage_drafts(model, params):
     """An adversarial draft source (every candidate wrong) must cost
     only throughput: output token-identical, acceptance ~0, and the

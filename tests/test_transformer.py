@@ -283,6 +283,7 @@ def test_lm_step_vocab_chunked_matches_dense(devices):
                                    atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow   # 27s compile — the tier-1 budget-discipline cut
 def test_lm_step_vocab_chunked_under_ddp(devices):
     """chunked_lm_loss (custom VJP) composes with the shard_map DDP
     strategy: 8-replica step == single-device step on the global batch."""
