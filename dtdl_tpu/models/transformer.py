@@ -33,8 +33,10 @@ import jax
 import jax.numpy as jnp
 
 from dtdl_tpu.ops.attention import flash_attention, mha_reference
+from dtdl_tpu.ops.paged_attention import paged_attention
 from dtdl_tpu.ops.rope import apply_rope, rope_frequencies
-from dtdl_tpu.quant import QuantDenseGeneral, canon_kv_dtype, kv_quantize
+from dtdl_tpu.quant import (QuantDenseGeneral, canon_kv_dtype, kv_quantize,
+                            kv_scale_dtype, weight_dtypes)
 
 Dtype = Any
 
@@ -103,7 +105,9 @@ class Attention(nn.Module):
     head_dim: int
     attn_impl: str = "flash"      # 'flash' | 'dense'
     dtype: Dtype = jnp.bfloat16
-    quantize: bool = False        # int8 weight-only projections (serve)
+    quantize: Any = False         # weight-only projections (serve):
+    #                               True/'int8' -> int8, 'w8f' -> fp8
+    paged_kernel: bool = False    # Pallas paged attend (kernel round 2)
 
     @nn.compact
     def __call__(self, x, cos, sin, decode: bool = False):
@@ -114,7 +118,7 @@ class Attention(nn.Module):
                 # layer, so quantize_params maps tree-to-tree
                 return QuantDenseGeneral(
                     features=(self.n_heads, self.head_dim), axis=-1,
-                    dtype=self.dtype, name=name)
+                    dtype=self.dtype, mode=self.quantize, name=name)
             return nn.DenseGeneral(
                 features=(self.n_heads, self.head_dim), axis=-1,
                 use_bias=False, dtype=self.dtype,
@@ -142,7 +146,7 @@ class Attention(nn.Module):
         if self.quantize:
             return QuantDenseGeneral(
                 features=d_model, axis=(-2, -1), dtype=self.dtype,
-                name="out")(o)
+                mode=self.quantize, name="out")(o)
         return nn.DenseGeneral(
             features=d_model, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             kernel_init=_part(nn.initializers.lecun_normal(),
@@ -239,9 +243,10 @@ class Attention(nn.Module):
         k = apply_rope(k, cos, sin, offset=pos)
         if quant:
             # quantize-on-scatter: each new position's K/V row is scaled
-            # off its own max (write-once — see quant.kv_quantize)
-            k8, ks = kv_quantize(k)
-            v8, vs = kv_quantize(v)
+            # off its own max (write-once — see quant.kv_quantize); the
+            # cache leaf's dtype picks the payload (int8 or fp8)
+            k8, ks = kv_quantize(k, dtype=ck.value.dtype)
+            v8, vs = kv_quantize(v, dtype=cv.value.dtype)
             ck.value = jax.lax.dynamic_update_slice(
                 ck.value, k8, (0, 0, pos, 0))
             cv.value = jax.lax.dynamic_update_slice(
@@ -346,8 +351,8 @@ class Attention(nn.Module):
         if quant:
             # quantize-on-scatter, per (row, head, position) — the same
             # write-once discipline as the scalar path (quant.kv_quantize)
-            k8, ks = kv_quantize(k)
-            v8, vs = kv_quantize(v)
+            k8, ks = kv_quantize(k, dtype=ck.value.dtype)
+            v8, vs = kv_quantize(v, dtype=cv.value.dtype)
             ck.value = scatter_row(ck.value, k8, pos)
             cv.value = scatter_row(cv.value, v8, pos)
             scatter_s = jax.vmap(
@@ -509,15 +514,45 @@ class Attention(nn.Module):
             # quantize-on-scatter through the SAME (page, offset)
             # coordinates: each new position's K/V row is scaled off its
             # own max, so append-only shared pages never need rescaling
-            k, ks = kv_quantize(k)
-            v, vs = kv_quantize(v)
+            k, ks = kv_quantize(k, dtype=pk.value.dtype)
+            v, vs = kv_quantize(v, dtype=pv.value.dtype)
+
+        scale = 1.0 / math.sqrt(d)
+        if self.paged_kernel:
+            # kernel round 2: scatter-only pool updates (no gathered
+            # [B, H, n_ptab*page, D] view exists), then the Pallas
+            # paged-attention kernel walks the table itself — page-
+            # granular DMAs with the scale fusion folded into the tile
+            # loads (dtdl_tpu/ops/paged_attention.py)
+            def scatter(pool, new):
+                if pool.ndim == 4:
+                    upd = new.transpose(0, 2, 1, 3).reshape(
+                        b * s_new, H, D)
+                    return pool.at[page_idx, :, off_idx, :].set(
+                        upd.astype(pool.dtype))
+                upd = new.transpose(0, 2, 1).reshape(b * s_new, H)
+                return pool.at[page_idx, :, off_idx].set(
+                    upd.astype(pool.dtype))
+
+            if quant:
+                pks.value = scatter(pks.value, ks)
+                pvs.value = scatter(pvs.value, vs)
+            pk.value = scatter(pk.value, k)
+            pv.value = scatter(pv.value, v)
+            ci.value = pos + s_new   # engine masks/rolls back, as dense
+            return paged_attention(
+                q, pk.value, pv.value, table, pos_safe, active,
+                scale=scale,
+                key_scale=pks.value if quant else None,
+                value_scale=pvs.value if quant else None)
+
+        if quant:
             pks.value, kss = update_and_view(pks.value, ks)
             pvs.value, vss = update_and_view(pvs.value, vs)
         pk.value, keys = update_and_view(pk.value, k)
         pv.value, values = update_and_view(pv.value, v)
         ci.value = pos + s_new   # engine masks/rolls back, as dense
 
-        scale = 1.0 / math.sqrt(d)
         qpos = pos_safe[:, None] + jnp.arange(s_new)[None, :]    # [B, S]
         mask = (jnp.arange(n_ptab * page)[None, None, :]
                 <= qpos[:, :, None])                     # [B, S, n_ptab*pg]
@@ -549,7 +584,8 @@ class Attention(nn.Module):
 class SwiGLU(nn.Module):
     d_ff: int
     dtype: Dtype = jnp.bfloat16
-    quantize: bool = False        # int8 weight-only wi/wg/wo (serve)
+    quantize: Any = False         # weight-only wi/wg/wo (serve):
+    #                               True/'int8' -> int8, 'w8f' -> fp8
 
     @nn.compact
     def __call__(self, x):
@@ -559,7 +595,8 @@ class SwiGLU(nn.Module):
             # layers, so quantize_params maps tree-to-tree
             def dense(features, name):
                 return QuantDenseGeneral(features=features, axis=-1,
-                                         dtype=self.dtype, name=name)
+                                         dtype=self.dtype,
+                                         mode=self.quantize, name=name)
         else:
             def dense(features, name):
                 # wo is the row-parallel projection whatever the
@@ -623,10 +660,10 @@ class MoE(nn.Module):
     # excluded from routing (they take no capacity).  0 = the measured
     # default cap of 1024
     group_size: int = 0
-    # int8 weight-only expert wi/wg/wo (serve): per-(expert, output
-    # channel) scales; the router stays f32 (O(d) bytes, high
-    # sensitivity — dtdl_tpu/quant/core.py)
-    quantize: bool = False
+    # weight-only expert wi/wg/wo (serve): per-(expert, output channel)
+    # scales, True/'int8' int8 or 'w8f' fp8; the router stays f32 (O(d)
+    # bytes, high sensitivity — dtdl_tpu/quant/core.py)
+    quantize: Any = False
 
     @nn.compact
     def __call__(self, x):
@@ -658,16 +695,17 @@ class MoE(nn.Module):
 
         def expert_param(name, shape, in_ax, out_ax):
             if self.quantize:
-                # int8 kernel + per-(expert, output-channel) scale, with
-                # the same param name (+ '_scale' sibling) so
+                # quantized kernel + per-(expert, output-channel) scale,
+                # with the same param name (+ '_scale' sibling) so
                 # quantize_params maps tree-to-tree; placeholder values
                 # — a quantized model is served, never trained
+                payload_dt, scale_dt = weight_dtypes(self.quantize)
                 q = self.param(name,
-                               lambda *_: jnp.zeros(shape, jnp.int8))
+                               lambda *_: jnp.zeros(shape, payload_dt))
                 s = self.param(
                     f"{name}_scale",
                     lambda *_: jnp.ones((shape[0], 1, shape[2]),
-                                        jnp.float32))
+                                        scale_dt))
                 return q.astype(self.dtype), s
             # batch_axis keeps the expert dim out of fan_in so every expert
             # initializes like its dense counterpart
@@ -712,7 +750,7 @@ class MoE(nn.Module):
         y = jnp.einsum(spec, x, kernel)
         if scale is not None:
             y = (y * scale.reshape(scale.shape[0], 1, 1, -1)
-                 ).astype(self.dtype)
+                 .astype(jnp.float32)).astype(self.dtype)
         return y
 
     def _routed(self, x, probs, w_in, w_gate, w_out):
@@ -800,13 +838,16 @@ class Block(nn.Module):
     capacity_factor: float = 1.25
     moe_top_k: int = 1
     moe_group_size: int = 0
-    quantize: bool = False        # int8 weight-only matmuls (serve)
+    quantize: Any = False         # weight-only matmuls (serve):
+    #                               True/'int8' -> int8, 'w8f' -> fp8
+    paged_kernel: bool = False    # Pallas paged attend (kernel round 2)
 
     @nn.compact
     def __call__(self, x, cos, sin, decode: bool = False):
         h = RMSNorm(dtype=self.dtype, name="ln_attn")(x)
         x = x + Attention(self.n_heads, self.head_dim, self.attn_impl,
                           self.dtype, quantize=self.quantize,
+                          paged_kernel=self.paged_kernel,
                           name="attn")(h, cos, sin, decode=decode)
         h = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
         if self.n_experts > 0:
@@ -839,13 +880,20 @@ class TransformerLM(nn.Module):
     attn_impl: str = "flash"
     remat: bool = False
     dtype: Dtype = jnp.bfloat16
-    # int8 weight-only serving: every matmul kernel becomes an int8
-    # tensor + per-output-channel f32 scale with dequant fused into the
-    # matmul (dtdl_tpu/quant/).  A quantized model is built as
-    # ``model.clone(quantize=True)`` and loaded via
+    # weight-only serving: every matmul kernel becomes a quantized
+    # tensor + per-output-channel scale with dequant fused into the
+    # matmul (dtdl_tpu/quant/) — ``True``/'int8' the int8+f32 recipe,
+    # 'w8f' the fp8+bf16 one.  A quantized model is built as
+    # ``model.clone(quantize=mode)`` and loaded via
     # ``quant.quantize_params`` — never trained.  Embedding, norms and
     # MoE routers stay f32 (see dtdl_tpu/quant/core.py for why).
-    quantize: bool = False
+    quantize: Any = False
+    # Pallas paged-attention decode kernel (kernel round 2): the paged
+    # arena's decode/verify attend walks the page table inside the
+    # kernel instead of gathering the whole logical view
+    # (dtdl_tpu/ops/paged_attention.py).  The serving engine resolves
+    # its 'auto' flag to this bool at construction.
+    paged_kernel: bool = False
 
     @property
     def head_dim(self):
@@ -866,7 +914,9 @@ class TransformerLM(nn.Module):
         max_seq] — :meth:`Attention._decode_attend` quantizes on scatter
         and dequants in the attention einsums on gather, so decode HBM
         traffic per cached byte halves vs bf16 (quarters vs f32) at the
-        cost of one scale float per position per head."""
+        cost of one scale float per position per head.
+        ``kv_dtype='fp8'`` is the same layout with a float8_e4m3fn
+        payload and bf16 scales (quant.kv_scale_dtype)."""
         kv_dtype = canon_kv_dtype(kv_dtype)
         shapes = jax.eval_shape(
             functools.partial(self.init, decode=True),
@@ -881,7 +931,8 @@ class TransformerLM(nn.Module):
                 if isinstance(tree, dict):
                     if "key" in tree and "index" in tree:
                         kv = tree["key"].shape          # [B, H, S, D]
-                        sc = jax.ShapeDtypeStruct(kv[:3], jnp.float32)
+                        sc = jax.ShapeDtypeStruct(
+                            kv[:3], kv_scale_dtype(kv_dtype))
                         return dict(
                             tree,
                             key=jax.ShapeDtypeStruct(kv, kv_dtype),
@@ -920,7 +971,10 @@ class TransformerLM(nn.Module):
         multiplier the serving engine's ``kv_pool_bytes`` sizing and
         compile_stats receipts expose).  Scales ride WITH their page
         (scattered/gathered through the same page table), so prefix-
-        cache sharing of int8 pages needs no extra bookkeeping."""
+        cache sharing of int8 pages needs no extra bookkeeping.
+        ``kv_dtype='fp8'`` swaps the payload for float8_e4m3fn and the
+        scale sidecars for bf16 — the byte win over int8 is entirely
+        the 2-vs-4-byte scales (quant.kv_scale_dtype)."""
         kv_dtype = canon_kv_dtype(kv_dtype)
         if page_size < 1 or self.max_seq % page_size:
             raise ValueError(
@@ -945,7 +999,8 @@ class TransformerLM(nn.Module):
                     }
                     if kv_dtype is not None:
                         sc = jax.ShapeDtypeStruct(
-                            (n_pages, H, page_size), jnp.float32)
+                            (n_pages, H, page_size),
+                            kv_scale_dtype(kv_dtype))
                         out["pages_key_scale"] = sc
                         out["pages_value_scale"] = sc
                     return out
@@ -999,6 +1054,7 @@ class TransformerLM(nn.Module):
                 moe_top_k=self.moe_top_k,
                 moe_group_size=self.moe_group_size,
                 quantize=self.quantize,
+                paged_kernel=self.paged_kernel,
                 name=f"block_{i}")
             # only pass the flag when set: a kwarg through nn.remat is
             # traced, and Attention branches on it in Python

@@ -154,8 +154,13 @@ def mha_reference(q, k, v, *, causal: bool = True, scale: float | None = None):
 
 # seq is bucketed to the next power of two in this range; larger sequences
 # use the 32768 entry (same tiling — block shape is seq-independent past
-# the knee, only the grid grows)
-_SEQ_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+# the knee, only the grid grows).  The sub-128 buckets cover the
+# page-granular tile shapes of the paged-attention decode kernel (kernel
+# round 2: page sizes 8-64, dtdl_tpu/ops/paged_attention.py), so
+# ``strict=True`` receipt checks over serving geometries resolve instead
+# of spuriously raising.
+_SEQ_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                16384, 32768)
 
 
 def _build_block_table():

@@ -82,18 +82,22 @@ class MegatronConfig:
     # 0.01 default is the Switch Transformer setting.  0 disables.
     moe_aux_weight: float = 0.01
     dtype: jnp.dtype = jnp.bfloat16
-    # fused-rope attend (round 19, the PR 8 known-remaining): when the
-    # 'seq' mesh axis is 1 (TP/PP-only meshes — no ring hops), the
-    # local attend IS the whole sequence and can ride the Pallas flash
+    # fused-rope attend (round 19; ring-fused in kernel round 2): when
+    # the 'seq' mesh axis is 1 (TP/PP-only meshes — no ring hops), the
+    # local attend IS the whole sequence and rides the Pallas flash
     # kernel with the rotary embedding folded into its tile loads
     # (flash_attention(rope_positions=)), killing the last apply_rope
     # HBM round-trip (8·L·B·H·S·D bytes/step — SCALING.md round 13).
-    # 'auto' fuses only on real TPU backends (the CPU fallback runs
-    # the kernel under the Pallas interpreter, where the fusion saves
-    # no bytes and costs interpret overhead); True forces it anywhere
-    # (the parity tests), False keeps the unfused path.  Sequence-
-    # parallel meshes (seq > 1) always use apply_rope + ring: K/V
-    # blocks rotate around the ring pre-roped.
+    # Sequence-parallel meshes (seq > 1) fuse through the ring instead:
+    # ring_attention(rope=(cos, sin)) rotates each K block *inside* the
+    # ppermute schedule at its owner's reconstructed zigzag positions,
+    # so the pre-ring apply_rope of K never materializes and the ring
+    # carries unrotated blocks — f32-exact vs the unfused path
+    # (dtdl_tpu/parallel/sequence.py).  'auto' fuses only on real TPU
+    # backends (the CPU fallback runs the flash kernel under the Pallas
+    # interpreter, where fusion saves no bytes and costs interpret
+    # overhead); True forces it anywhere (the parity tests), False
+    # keeps the unfused apply_rope paths.
     fuse_rope: object = "auto"
 
     def __post_init__(self):
@@ -266,14 +270,8 @@ def _attention(cfg, p, x, cos, sin):
     sp = lax.axis_size(SEQ)               # static: the mesh is known
     fuse = cfg.fuse_rope
     if fuse == "auto":
-        fuse = sp == 1 and jax.default_backend() == "tpu"
-    if fuse and sp > 1:
-        raise ValueError(
-            "fuse_rope=True needs a 'seq' mesh axis of 1: under "
-            "sequence parallelism K/V blocks rotate around the ring "
-            "already roped, so the rotation cannot ride the local "
-            "kernel's tile loads")
-    if fuse:
+        fuse = jax.default_backend() == "tpu"
+    if fuse and sp == 1:
         # seq axis of 1: no ring hops — the local attend IS the whole
         # sequence, so the rotary embedding rides the flash kernel's
         # HBM→VMEM tile loads (round 13) instead of a per-layer
@@ -281,6 +279,13 @@ def _attention(cfg, p, x, cos, sin):
         # n=1, so the kernel's index-causal mask == position-causal.
         o = flash_attention(q, k, v, causal=True, rope=(cos, sin),
                             rope_positions=(pos, pos))
+    elif fuse:
+        # seq axis > 1 (kernel round 2): the rotation rides the ring —
+        # q/k go in unrotated and ring_attention rotates each K block
+        # at its owner's zigzag positions inside the ppermute schedule,
+        # skipping the pre-ring apply_rope materialization of K.
+        o = ring_attention(q, k, v, axis_name=SEQ, causal=True,
+                           layout="zigzag", rope=(cos, sin))
     else:
         q = apply_rope(q, cos, sin, positions=pos)
         k = apply_rope(k, cos, sin, positions=pos)

@@ -95,6 +95,34 @@ def zigzag_positions(axis_name: str, s_loc: int):
     return jnp.concatenate([low, high])
 
 
+def _owner_positions(layout: str, n: int, owner, s_loc: int):
+    """Global positions of ``owner``'s local rows under ``layout``: [s_loc].
+
+    ``owner`` may be traced (the reconstructed ring source ``src``).  The
+    zigzag case is :func:`zigzag_positions` generalized to any owner; at
+    n == 1 both layouts reduce to ``arange(s_loc)``.
+    """
+    if layout == "zigzag" and n > 1:
+        c = s_loc // 2
+        low = owner * c + jnp.arange(c)
+        high = (2 * n - 1 - owner) * c + jnp.arange(c)
+        return jnp.concatenate([low, high])
+    return owner * s_loc + jnp.arange(s_loc)
+
+
+def _rope_block(x, rope, positions):
+    """Rotate a K block at its owner's global positions (no-op when
+    ``rope`` is None).  The ring carries K **unrotated** and rotates a
+    local copy at each use — elementwise the identical f32 arithmetic as
+    pre-roping before the ring (apply_rope commutes with the ppermute
+    and with chunk slicing), so the fused and unfused paths are exact."""
+    if rope is None:
+        return x
+    from dtdl_tpu.ops.rope import apply_rope
+    cos, sin = rope
+    return apply_rope(x, cos, sin, positions=positions)
+
+
 def _online_update(q_rows, k_blk, v_blk, o, m, l, scale, mask=None):
     """One online-softmax accumulation of (o, m, l) rows against a K/V block.
 
@@ -119,7 +147,7 @@ def _online_update(q_rows, k_blk, v_blk, o, m, l, scale, mask=None):
 
 def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
                    causal: bool = True, scale: float | None = None,
-                   layout: str = "contiguous"):
+                   layout: str = "contiguous", rope=None):
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
     Call inside ``shard_map``; q/k/v are the local shards
@@ -129,11 +157,20 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     chunks ``i`` and ``2n-1-i`` of a ``2n``-chunk split (build the global
     order with :func:`zigzag_order`) — the layout that load-balances causal
     masking across the ring.  Returns the local output shard (same layout).
+
+    ``rope=(cos, sin)`` fuses the rotary embedding into the ring (kernel
+    round 2): q/k arrive **unrotated**, q is rotated once at the local
+    shard's layout positions, and every K block is rotated *inside* the
+    schedule at its original owner's reconstructed positions — the roped
+    K tensor never materializes as a pre-ring HBM round-trip and the
+    ppermute carries the compact unrotated block.  f32-exact vs roping
+    before the call (see :func:`_rope_block`).
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
     if layout == "zigzag" and causal and _axis_size(axis_name) > 1:
-        return _ring_zigzag_causal(q, k, v, axis_name=axis_name, scale=scale)
+        return _ring_zigzag_causal(q, k, v, axis_name=axis_name, scale=scale,
+                                   rope=rope)
     # non-causal attention touches every block regardless of layout, so the
     # zigzag non-causal case is exactly the contiguous schedule below.
     n = _axis_size(axis_name)
@@ -141,6 +178,8 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     b, h, s_loc, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if rope is not None:
+        q = _rope_block(q, rope, _owner_positions(layout, n, my, s_loc))
 
     pos_q = my * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
 
@@ -156,7 +195,9 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
                 pos_k = src * s_loc + lax.broadcasted_iota(
                     jnp.int32, (s_loc, s_loc), 1)
                 mask = pos_q >= pos_k
-            return _online_update(q, k_blk, v_blk, o, m, l, scale, mask)
+            k_r = _rope_block(k_blk, rope,
+                              _owner_positions(layout, n, src, s_loc))
+            return _online_update(q, k_r, v_blk, o, m, l, scale, mask)
 
         if causal:
             # blocks strictly above the diagonal (src > my) are fully
@@ -179,7 +220,8 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     return (o / l).astype(q.dtype)
 
 
-def _ring_zigzag_causal(q, k, v, *, axis_name: str, scale: float | None):
+def _ring_zigzag_causal(q, k, v, *, axis_name: str, scale: float | None,
+                        rope=None):
     """Causal ring attention over the zigzag layout — balanced schedule.
 
     Device i holds chunks ``(i, 2n-1-i)`` of a ``2n``-chunk global split.
@@ -198,6 +240,12 @@ def _ring_zigzag_causal(q, k, v, *, axis_name: str, scale: float | None):
     Every device therefore does exactly half a block of matmul per ring
     step: the causal FLOP saving is also a critical-path saving, unlike the
     contiguous layout's skip.
+
+    ``rope=(cos, sin)``: q/k arrive unrotated; q and the step-0 diagonal K
+    are rotated at the local zigzag positions, ring-arrived K blocks at
+    their owner ``src``'s reconstructed zigzag positions — always on the
+    chunk actually attended (rope is elementwise, so rotating the slice ==
+    slicing the rotation).  The scan carries K unrotated.
     """
     n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -218,7 +266,9 @@ def _ring_zigzag_causal(q, k, v, *, axis_name: str, scale: float | None):
 
     # step 0: local diagonal, full block under the zigzag causal mask
     pos = zigzag_positions(axis_name, s_loc)
-    o, m, l = attend(q, k, v, o0, m0, l0,
+    if rope is not None:
+        q = _rope_block(q, rope, pos)
+    o, m, l = attend(q, _rope_block(k, rope, pos), v, o0, m0, l0,
                      mask=pos[:, None] >= pos[None, :])
     if n == 1:
         return (o / l).astype(q.dtype)
@@ -231,11 +281,17 @@ def _ring_zigzag_causal(q, k, v, *, axis_name: str, scale: float | None):
         src = (my - t) % n
 
         def from_earlier(o, m, l):           # src < my: q_all vs kv low chunk
-            return attend(q, k_blk[:, :, :c], v_blk[:, :, :c], o, m, l)
+            k_low = _rope_block(k_blk[:, :, :c], rope,
+                                src * c + jnp.arange(c))
+            return attend(q, k_low, v_blk[:, :, :c], o, m, l)
 
         def from_later(o, m, l):             # src > my: q high chunk vs kv all
+            k_full = _rope_block(
+                k_blk, rope,
+                jnp.concatenate([src * c + jnp.arange(c),
+                                 (2 * n - 1 - src) * c + jnp.arange(c)]))
             o_hi, m_hi, l_hi = attend(
-                q[:, :, c:], k_blk, v_blk,
+                q[:, :, c:], k_full, v_blk,
                 o[:, :, c:], m[:, :, c:], l[:, :, c:])
             return (jnp.concatenate([o[:, :, :c], o_hi], axis=2),
                     jnp.concatenate([m[:, :, :c], m_hi], axis=2),

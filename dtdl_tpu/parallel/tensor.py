@@ -77,18 +77,21 @@ def logical_shardings(mesh: Mesh, tree, rules="tp"):
     return nn.logical_to_mesh_sharding(specs, mesh, list(rules))
 
 
-def quant_logical_shardings(mesh: Mesh, model, rules="tp"):
+def quant_logical_shardings(mesh: Mesh, model, rules="tp", mode=True):
     """NamedShardings for a ``quantize_params`` tree (round 20 — the
-    PR 14 known-remaining TP+quantize composition).
+    PR 14 known-remaining TP+quantize composition; ``mode`` picks the
+    recipe since kernel round 2: ``True`` int8+f32, ``'w8f'`` fp8+bf16
+    — the specs are dtype-independent, so both modes share this map).
 
     The quantized clone's params carry no flax logical-axis metadata
     (``QuantDenseGeneral`` declares plain placeholders — a quantized
     model is served, never trained), so ``logical_shardings`` cannot
     shard them.  But the layout is fully determined by the f32 tree:
 
-    * every int8 ``kernel`` keeps its f32 twin's module path AND shape
-      (dtdl_tpu/quant/core.py), so it inherits the twin's spec verbatim
-      — column/row-parallel exactly like the weights it replaces;
+    * every quantized ``kernel`` keeps its f32 twin's module path AND
+      shape (dtdl_tpu/quant/core.py), so it inherits the twin's spec
+      verbatim — column/row-parallel exactly like the weights it
+      replaces;
     * every ``<name>_scale`` sibling is its tensor's shape with the
       contracted dims as keepdims 1s, so its spec is the tensor's spec
       with every size-1 dim unsharded — a 'model'-sharded output
@@ -113,7 +116,7 @@ def quant_logical_shardings(mesh: Mesh, model, rules="tp"):
         tokens)["params"]
     f_sh = logical_shardings(mesh, boxed, rules)
     q_abs = nn.unbox(jax.eval_shape(
-        functools.partial(model.clone(quantize=True).init, rng),
+        functools.partial(model.clone(quantize=mode or True).init, rng),
         tokens)["params"])
 
     def scale_spec(tensor_sharding, scale_shape):

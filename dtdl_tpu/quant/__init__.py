@@ -11,11 +11,15 @@ pytree, swaps the model for its ``quantize=True`` clone (dequant-in-
 kernel matmuls), and builds the int8+scales KV arena the attention
 paths consume.  Same three compiled program families, zero new
 programs; see dtdl_tpu/quant/core.py for the recipe and the byte
-arithmetic, tests/test_quant.py for the parity contracts.
+arithmetic, tests/test_quant.py for the parity contracts.  Kernel
+round 2 adds the fp8 variants (``quantize_weights='w8f'`` /
+``kv_dtype='fp8'``) through the same schema.
 """
 
 from dtdl_tpu.quant.core import (  # noqa: F401
-    SCALE_SUFFIX, canon_kv_dtype, dequantize_params, kv_quantize,
-    quantize_params, quantize_tensor, tree_bytes,
+    FP8_DTYPE, FP8_MAX, Fp8UnsupportedError, SCALE_SUFFIX,
+    canon_kv_dtype, canon_weight_quant, dequantize_params, fp8_supported,
+    kv_quantize, kv_scale_dtype, quantize_params, quantize_tensor,
+    tree_bytes, weight_dtypes,
 )
 from dtdl_tpu.quant.layers import QuantDenseGeneral  # noqa: F401

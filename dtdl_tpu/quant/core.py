@@ -42,6 +42,20 @@ the [.., positions]-shaped logits, the value scale folds into the
 softmax weights — no dequantized [.., D] copy is ever materialized).
 See models/transformer.py `_verify_attend_slots` / `_paged_attend_slots`.
 
+**fp8 (kernel round 2)** — the same two recipes with a float8_e4m3fn
+payload: weights under ``quantize_weights='w8f'`` (per-output-channel
+``amax/448`` scales, stored bf16), KV under ``kv_dtype='fp8'``
+(write-once per-position bf16 scales).  Same pytree schema, same
+scale-sidecar naming, same fused dequant sites — only the payload and
+scale dtypes change, which is why ``quantize_params`` reads both off
+the quantized clone's schema instead of hardcoding int8.  Two fp8
+traps are handled centrally: casts to fp8 do NOT saturate (overflow is
+NaN — every quantizer clips to ±448 in f32 first), and the stored bf16
+scale must be EXACTLY the divisor used at quantize time (each scale is
+round-tripped through bf16 before the divide).  Builds without the
+dtype refuse by name at engine construction
+(:class:`Fp8UnsupportedError`), never inside a traced function.
+
 The **QuantizedParams pytree** returned by :func:`quantize_params` is a
 plain nested dict with the SAME module paths as the source params —
 each quantized kernel keeps its name and gains an ``<name>_scale``
@@ -62,32 +76,117 @@ import numpy as np
 #: suffix linking a quantized tensor to its scale in the params pytree
 SCALE_SUFFIX = "_scale"
 
+#: float8_e4m3fn when this jax build ships it (ml_dtypes), else None —
+#: the capability gate behind every fp8 entry point.  ±448 is the
+#: format's finite max; casts do NOT saturate (overflow -> NaN), so
+#: every fp8 quantizer here clips in f32 first.
+FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+FP8_MAX = 448.0
+
+
+class Fp8UnsupportedError(ValueError):
+    """fp8 was requested in a configuration that cannot serve it —
+    raised by name at ENGINE CONSTRUCTION (quantize_weights='w8f' /
+    kv_dtype='fp8' on a jax build without float8_e4m3fn, or fp8 weights
+    under a mesh whose sharding rules aren't a named preset with a
+    quant rule map), never from inside a traced function."""
+
+
+def fp8_supported() -> bool:
+    """Whether this jax build can represent fp8 (float8_e4m3fn)."""
+    return FP8_DTYPE is not None
+
+
+def _is_fp8(dtype) -> bool:
+    return FP8_DTYPE is not None and np.dtype(dtype) == np.dtype(FP8_DTYPE)
+
 
 def canon_kv_dtype(kv_dtype):
     """Normalize a ``kv_dtype`` argument: ``None`` (store K/V at the
-    model dtype — today's behavior) or int8 (accepts ``jnp.int8`` /
-    ``np.int8`` / ``"int8"``), anything else is a named error."""
+    model dtype — today's behavior), int8 (accepts ``jnp.int8`` /
+    ``np.int8`` / ``"int8"``) or fp8 (``"fp8"`` / ``"float8_e4m3fn"`` /
+    the dtype itself), anything else is a named error."""
     if kv_dtype is None:
         return None
+    if kv_dtype == "fp8" or (isinstance(kv_dtype, str)
+                             and kv_dtype == "float8_e4m3fn"):
+        if FP8_DTYPE is None:
+            raise Fp8UnsupportedError(
+                "kv_dtype='fp8' needs a jax build with float8_e4m3fn "
+                "(ml_dtypes); this one has none")
+        return FP8_DTYPE
     try:
         if np.dtype(kv_dtype) == np.dtype(np.int8):
             return jnp.int8
+        if _is_fp8(kv_dtype):
+            return FP8_DTYPE
     except TypeError:
         pass
-    raise ValueError(f"kv_dtype must be None (model dtype) or int8, "
-                     f"got {kv_dtype!r}")
+    raise ValueError(f"kv_dtype must be None (model dtype), int8 or "
+                     f"fp8, got {kv_dtype!r}")
 
 
-def quantize_tensor(w, scale_shape):
-    """Symmetric per-channel int8 of one weight tensor.
+def kv_scale_dtype(kv_dtype):
+    """Scale-sidecar dtype for a quantized KV arena: f32 for int8
+    (legacy layout, pinned by the round-7 byte receipts), bf16 for fp8
+    — a 4-byte scale per position would eat half of fp8's win over
+    int8+f32, and bf16's 8 mantissa bits are what the fp8 payload can
+    resolve anyway."""
+    kv_dtype = canon_kv_dtype(kv_dtype)
+    if kv_dtype is None:
+        return None
+    return jnp.bfloat16 if _is_fp8(kv_dtype) else jnp.float32
+
+
+def canon_weight_quant(mode):
+    """Normalize a ``quantize_weights`` argument: ``False``/``None`` ->
+    ``False``; ``True`` / ``"int8"`` / int8 -> ``True`` (the round-12
+    int8 recipe); ``"w8f"`` / ``"fp8"`` / fp8 -> ``"w8f"``
+    (per-channel-scaled float8_e4m3fn).  Anything else is a named
+    error, raised here so the engine refuses at construction."""
+    if mode is None or mode is False:
+        return False
+    if mode is True or mode == "int8":
+        return True
+    if mode in ("w8f", "fp8"):
+        if FP8_DTYPE is None:
+            raise Fp8UnsupportedError(
+                "quantize_weights='w8f' needs a jax build with "
+                "float8_e4m3fn (ml_dtypes); this one has none")
+        return "w8f"
+    try:
+        if np.dtype(mode) == np.dtype(np.int8):
+            return True
+        if _is_fp8(mode):
+            return "w8f"
+    except TypeError:
+        pass
+    raise ValueError(f"quantize_weights must be False, True/'int8' or "
+                     f"'w8f' (fp8), got {mode!r}")
+
+
+def weight_dtypes(mode):
+    """(payload, scale) dtypes of a quantized weight for ``mode`` (a
+    :func:`canon_weight_quant` output): int8+f32 or fp8+bf16."""
+    if mode == "w8f":
+        return FP8_DTYPE, jnp.bfloat16
+    return jnp.int8, jnp.float32
+
+
+def quantize_tensor(w, scale_shape, dtype=jnp.int8):
+    """Symmetric per-channel quantization of one weight tensor.
 
     ``scale_shape`` is ``w.shape`` with every *contracted* (input) dim
     set to 1 — the keepdims layout the quantized modules declare, which
     is what makes this function generic over Dense / DenseGeneral /
-    per-expert kernels: the 1-dims name the reduction axes.  Returns
-    ``(q int8, scale f32)`` with ``w ≈ q * scale`` (broadcast) and
-    ``|w - q·scale| <= scale/2`` elementwise; all-zero channels get
-    scale 1 so nothing divides by zero.
+    per-expert kernels: the 1-dims name the reduction axes.  ``dtype``
+    selects the payload: int8 (default — returns ``(q int8, scale
+    f32)`` with ``|w - q·scale| <= scale/2``) or float8_e4m3fn
+    (``scale_c = max|w[..., c]| / 448``, scale stored bf16 — the weight
+    is divided by the bf16-ROUNDED scale so the stored sidecar is
+    exactly the dequant multiplier, and clipped to ±448 in f32 before
+    the cast because fp8 casts overflow to NaN, not saturate).
+    All-zero channels get scale 1 so nothing divides by zero.
     """
     w = jnp.asarray(w)
     if len(scale_shape) != w.ndim or any(
@@ -98,27 +197,38 @@ def quantize_tensor(w, scale_shape):
     w32 = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True) if axes \
         else jnp.abs(w32)
+    if _is_fp8(dtype):
+        scale = jnp.where(amax > 0, amax / FP8_MAX, 1.0)
+        scale = scale.astype(jnp.bfloat16).astype(jnp.float32)
+        q = jnp.clip(w32 / scale, -FP8_MAX, FP8_MAX).astype(FP8_DTYPE)
+        return q, scale.astype(jnp.bfloat16)
+    if np.dtype(dtype) != np.dtype(np.int8):
+        raise ValueError(f"quantize_tensor supports int8 or fp8 "
+                         f"payloads, got {np.dtype(dtype)}")
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
 
 
-def quantize_params(model, params):
+def quantize_params(model, params, mode=True):
     """f32/bf16 params -> the QuantizedParams pytree of
-    ``model.clone(quantize=True)``.
+    ``model.clone(quantize=mode)``.
 
     ``model`` is the UNQUANTIZED model the params belong to; its
     quantized clone's abstract param tree (``jax.eval_shape`` of init —
     no compute) is the schema: wherever that tree carries a
     ``<name>_scale`` sibling, ``params[<name>]`` is quantized with
     :func:`quantize_tensor` (the scale's keepdims shape names the
-    reduction axes); every other leaf passes through untouched (embed,
-    norms, router — see module docstring).  Structure mismatches raise
-    with the offending path instead of silently dropping weights.
+    reduction axes, the schema leaf's DTYPE names the payload — int8 or
+    fp8, so one walk serves both recipes); every other leaf passes
+    through untouched (embed, norms, router — see module docstring).
+    Structure mismatches raise with the offending path instead of
+    silently dropping weights.  ``mode`` is a
+    :func:`canon_weight_quant` value (``True`` int8, ``'w8f'`` fp8).
     """
     import flax.linen as nn
 
-    qmodel = model.clone(quantize=True)
+    qmodel = model.clone(quantize=canon_weight_quant(mode) or True)
     params = nn.unbox(params)
     shapes = nn.unbox(jax.eval_shape(
         qmodel.init, jax.random.PRNGKey(0),
@@ -149,7 +259,8 @@ def quantize_params(model, params):
                         f"{'/'.join(path + (name + SCALE_SUFFIX,))}: "
                         f"the tree is already quantized")
                 q, s = quantize_tensor(
-                    src[name], ref[f"{name}{SCALE_SUFFIX}"].shape)
+                    src[name], ref[f"{name}{SCALE_SUFFIX}"].shape,
+                    dtype=ref[name].dtype)
                 out[name], out[f"{name}{SCALE_SUFFIX}"] = q, s
             else:
                 out[name] = conv(src[name], sub, path + (name,))
@@ -184,15 +295,25 @@ def dequantize_params(qparams):
     return conv(qparams)
 
 
-def kv_quantize(x):
-    """Per-(…, position) symmetric int8 for a K/V tensor ``[..., D]``:
-    returns ``(q int8 [..., D], scale f32 [...])`` with
+def kv_quantize(x, dtype=jnp.int8):
+    """Per-(…, position) symmetric quantization for a K/V tensor
+    ``[..., D]``: returns ``(q [..., D], scale [...])`` with
     ``x ≈ q * scale[..., None]``.  The scale comes from the new row's
     own max — write-once, so a cache position never needs rescaling
-    after later writes (the append-only discipline int8 KV arenas
-    require)."""
+    after later writes (the append-only discipline quantized KV arenas
+    require).  ``dtype`` int8 (default) keeps the round-7 layout
+    (int8 payload, f32 scale); float8_e4m3fn stores an fp8 payload with
+    a bf16 scale (:func:`kv_scale_dtype`) — the row is divided by the
+    bf16-ROUNDED scale and clipped to ±448 in f32 before the cast
+    (fp8 casts overflow to NaN, not saturate)."""
     x32 = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(x32), axis=-1)
+    if _is_fp8(dtype):
+        scale = jnp.where(amax > 0, amax / FP8_MAX, 1.0)
+        scale = scale.astype(jnp.bfloat16).astype(jnp.float32)
+        q = jnp.clip(x32 / scale[..., None],
+                     -FP8_MAX, FP8_MAX).astype(FP8_DTYPE)
+        return q, scale.astype(jnp.bfloat16)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.round(x32 / scale[..., None]).astype(jnp.int8)
     return q, scale
@@ -200,6 +321,8 @@ def kv_quantize(x):
 
 def tree_bytes(tree) -> int:
     """Total bytes of a pytree of arrays or ShapeDtypeStructs — the
-    byte receipts ``InferenceEngine.compile_stats`` reports."""
+    byte receipts ``InferenceEngine.compile_stats`` reports.  Generic
+    over every payload the arenas use (``np.dtype`` itemsize covers the
+    ml_dtypes fp8 types: float8_e4m3fn is 1 byte)."""
     return int(sum(math.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
                    for leaf in jax.tree.leaves(tree)))

@@ -1,4 +1,4 @@
-"""int8 weight-only dense layers — dequant-in-kernel matmuls.
+"""Quantized weight-only dense layers — dequant-in-kernel matmuls.
 
 :class:`QuantDenseGeneral` is a drop-in for the bias-free
 ``nn.DenseGeneral`` the transformer's projections use: same module name,
@@ -9,13 +9,18 @@ forward is the scale-fused ``lax.dot_general``:
 
     y = dot_general(x, q.astype(dtype)) * scale
 
-The int8→dtype convert is element-wise on a dot operand, which XLA
+The payload→dtype convert is element-wise on a dot operand, which XLA
 fuses into the matmul's HBM read — the weight crosses HBM as ONE byte
 per element and no f32/bf16 copy of it is ever materialized.  Because
 the scale is per output channel (constant along every contracted dim)
 the output multiply is *exactly* the dequantized matmul, not an
 approximation of it: the only error vs f32 is the per-channel rounding
-of the stored int8 (|w - q·s| <= s/2, dtdl_tpu/quant/core.py).
+of the stored payload (|w - q·s| <= s/2 for int8,
+dtdl_tpu/quant/core.py).  ``mode`` picks the payload/scale dtype pair:
+``True``/'int8' -> int8 + f32 (round 12), ``'w8f'`` -> float8_e4m3fn +
+bf16 (kernel round 2 — fp8's relative-precision grid replaces int8's
+fixed 127-step one, so the error bound is multiplicative, ~2^-3
+relative, instead of the additive s/2).
 """
 
 from __future__ import annotations
@@ -26,20 +31,23 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from dtdl_tpu.quant.core import weight_dtypes
+
 
 class QuantDenseGeneral(nn.Module):
-    """Bias-free ``nn.DenseGeneral`` over an int8 kernel + f32
+    """Bias-free ``nn.DenseGeneral`` over a quantized kernel + a
     per-output-feature scale (see module docstring).  ``axis`` names the
     input dims to contract (the transformer uses ``-1`` for q/k/v/mlp
     and ``(-2, -1)`` for the attention out-projection); params are
-    ``kernel`` int8 ``[*in_dims, *features]`` and ``kernel_scale`` f32
-    ``[1…1, *features]`` — init yields placeholder zeros/ones, real
-    values come from ``quantize_params`` (a quantized model is never
-    trained, only served)."""
+    ``kernel`` ``[*in_dims, *features]`` and ``kernel_scale``
+    ``[1…1, *features]`` in the dtypes ``mode`` selects — init yields
+    placeholder zeros/ones, real values come from ``quantize_params``
+    (a quantized model is never trained, only served)."""
 
     features: Any          # int or tuple of output feature dims
     axis: Any = -1         # int or tuple of input axes to contract
     dtype: Any = jnp.bfloat16
+    mode: Any = True       # True/'int8' -> int8+f32, 'w8f' -> fp8+bf16
 
     @nn.compact
     def __call__(self, x):
@@ -49,15 +57,18 @@ class QuantDenseGeneral(nn.Module):
         axis = tuple(sorted(a % x.ndim for a in axis))
         in_shape = tuple(x.shape[a] for a in axis)
         n_in = len(in_shape)
+        payload_dtype, scale_dtype = weight_dtypes(self.mode)
         kernel = self.param(
-            "kernel", lambda *_: jnp.zeros(in_shape + features, jnp.int8))
+            "kernel",
+            lambda *_: jnp.zeros(in_shape + features, payload_dtype))
         scale = self.param(
             "kernel_scale",
-            lambda *_: jnp.ones((1,) * n_in + features, jnp.float32))
+            lambda *_: jnp.ones((1,) * n_in + features, scale_dtype))
         y = jax.lax.dot_general(
             x.astype(self.dtype), kernel.astype(self.dtype),
             ((axis, tuple(range(n_in))), ((), ())))
         # scale-fused dequant: f32 multiply on the (small) matmul output,
         # cast back to the compute dtype — bitwise the dequantized matmul
         # for f32 models, one rounding for bf16
-        return (y * scale.reshape(features)).astype(self.dtype)
+        return (y * scale.reshape(features).astype(jnp.float32)
+                ).astype(self.dtype)

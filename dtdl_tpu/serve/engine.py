@@ -79,7 +79,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dtdl_tpu.ops.attention import block_table_entry, resolve_blocks
-from dtdl_tpu.quant import canon_kv_dtype, quantize_params, tree_bytes
+from dtdl_tpu.ops.paged_attention import paged_kernel_enabled
+from dtdl_tpu.quant import (Fp8UnsupportedError, canon_kv_dtype,
+                            canon_weight_quant, quantize_params, tree_bytes)
 from dtdl_tpu.serve.sampling import (FILTER_IMPL, SampleParams,
                                      accept_resample, pack, sample)
 
@@ -187,26 +189,59 @@ class InferenceEngine:
     carries the exact byte receipts.  For paged arenas,
     ``kv_pool_bytes`` sizes ``n_pages`` from an HBM byte budget
     instead: at a fixed budget an int8 pool holds ~2x the pages of a
-    bf16 one (~4x an f32 one) — the slots-per-HBM-byte win."""
+    bf16 one (~4x an f32 one) — the slots-per-HBM-byte win.
+
+    **Kernel round 2** adds the fp8 variants through the same kwargs —
+    ``quantize_weights='w8f'`` (float8_e4m3fn kernels, bf16 scales) and
+    ``kv_dtype='fp8'`` (fp8 pools, bf16 write-once scale sidecars) —
+    and ``paged_kernel=`` ('auto' default: on TPU, paged decode/verify
+    attend through the Pallas paged-attention kernel in
+    dtdl_tpu/ops/paged_attention.py — page-table walk inside the
+    kernel, page-granular DMA, dequant folded into the tile loads;
+    elsewhere the round-6 gather path.  ``True`` forces the kernel —
+    on CPU that means the Pallas interpreter, tests only).  Unsupported
+    fp8 combinations refuse by NAME at construction
+    (quant.Fp8UnsupportedError), never inside a traced program."""
 
     def __init__(self, model, params, n_slots: int = 8, buckets=None,
                  observer=None, page_size: int = 0,
                  n_pages: int | None = None,
-                 quantize_weights: bool = False, kv_dtype=None,
-                 kv_pool_bytes: int | None = None, mesh=None,
-                 rules="tp"):
+                 quantize_weights=False, kv_dtype=None,
+                 kv_pool_bytes: int | None = None, paged_kernel="auto",
+                 mesh=None, rules="tp"):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
-        self.quantized_weights = bool(quantize_weights)
+        # canonicalization raises the NAMED fp8 errors here, at
+        # construction (Fp8UnsupportedError on builds without
+        # float8_e4m3fn), never from inside a traced program
+        self.weight_mode = canon_weight_quant(quantize_weights)
+        self.quantized_weights = self.weight_mode
         self.kv_dtype = canon_kv_dtype(kv_dtype)
-        if quantize_weights:
+        if self.weight_mode == "w8f" and mesh is not None \
+                and not isinstance(rules, str):
+            raise Fp8UnsupportedError(
+                "fp8 weights (quantize_weights='w8f') under a mesh need "
+                "a NAMED rule preset (parallel/tensor.py RULE_PRESETS): "
+                "the quant rule map derives fp8 kernel+scale specs from "
+                "the f32 twin per preset; got a raw rules sequence")
+        # kernel round 2: resolve the paged-attention kernel flag ONCE
+        # ('auto' -> TPU only; True forces the interpreter on CPU) and
+        # bake it into the model as a static field — same three program
+        # families, the kernel only changes what decode/verify contain
+        self._paged_kernel_flag = paged_kernel
+        self.paged_kernel = (paged_kernel_enabled(paged_kernel)
+                             and page_size > 0)
+        if self.paged_kernel:
+            model = model.clone(paged_kernel=True)
+        if self.weight_mode:
             # params are the UNQUANTIZED tree the caller trained/loaded;
-            # the quantized clone declares the int8+scale schema.  On a
-            # mesh, the quant-aware rule map below (round 20) shards the
-            # int8 kernels on their f32 twins' logical axes and each
-            # _scale sibling alongside its tensor.
-            params = quantize_params(model, params)
-            model = model.clone(quantize=True)
+            # the quantized clone declares the payload+scale schema
+            # (int8+f32 or fp8+bf16).  On a mesh, the quant-aware rule
+            # map below (round 20) shards the quantized kernels on their
+            # f32 twins' logical axes and each _scale sibling alongside
+            # its tensor.
+            params = quantize_params(model, params, self.weight_mode)
+            model = model.clone(quantize=self.weight_mode)
         self.model = model
         self.params = nn.unbox(params)   # plain leaves either way
         # tensor-parallel serving proper (round 19, ROADMAP item 3): a
@@ -231,12 +266,15 @@ class InferenceEngine:
                     f"n_heads={self.model.n_heads} must divide by the "
                     f"mesh's tensor-parallel axis size {tp} "
                     f"(rules={rules!r})")
-            if quantize_weights:
+            if self.weight_mode:
                 # the quantized tree carries no flax logical metadata;
-                # the quant rule map derives int8-kernel + scale specs
-                # from the f32 twin (parallel/tensor.py, round 20)
+                # the quant rule map derives quantized-kernel + scale
+                # specs from the f32 twin (parallel/tensor.py, round
+                # 20; mode-aware since kernel round 2 — fp8 leaves
+                # shard exactly like their int8 counterparts)
                 param_sh = quant_logical_shardings(mesh, self.model,
-                                                   rules)
+                                                   rules,
+                                                   mode=self.weight_mode)
             else:
                 abs_boxed = jax.eval_shape(
                     functools.partial(self.model.init,
@@ -588,6 +626,17 @@ class InferenceEngine:
                         "explicit": entry is not None,
                     },
                     "sampling": FILTER_IMPL,
+                    # kernel round 2: whether decode/verify attend
+                    # through the Pallas paged kernel (page-granular
+                    # DMA, scale fusion in the tile loads) instead of
+                    # the whole-pool gather — same program families
+                    # either way, so this is config, not a count
+                    "paged_attention": {
+                        "requested": self._paged_kernel_flag,
+                        "enabled": self.paged_kernel,
+                        "page_size": self.page_size,
+                        "fused_scales": self.kv_dtype is not None,
+                    },
                 },
                 "decode": n(self._decode_fn) if self._decode_fn else 0,
                 "verify": {k: n(f) for k, f in self._verify_fns.items()},
@@ -598,8 +647,10 @@ class InferenceEngine:
                           if self.paged else None),
                 "quant": {
                     "weights": self.quantized_weights,
-                    "kv_dtype": ("int8" if self.kv_dtype is not None
-                                 else None),
+                    "kv_dtype": (None if self.kv_dtype is None
+                                 else "int8"
+                                 if self.kv_dtype == jnp.int8
+                                 else "fp8"),
                     "param_bytes": param_bytes,
                     "kv_payload_bytes": payload,
                     "kv_scale_bytes": scales,

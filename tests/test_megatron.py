@@ -754,23 +754,35 @@ def test_fused_rope_attend_matches_unfused(devices):
     assert got["accuracy"] == ref["accuracy"]
 
 
-def test_fused_rope_refused_under_sequence_parallelism(devices):
-    """fuse_rope=True on a seq>1 mesh must fail by name at trace time:
-    ring K/V blocks rotate pre-roped, so the rotation cannot ride the
-    local kernel — silently falling back would misreport the perf
-    claim."""
+def test_ring_fused_rope_matches_unfused_under_sequence_parallelism(devices):
+    """fuse_rope=True on a seq>1 mesh (kernel round 2) rides the ring:
+    ring_attention(rope=(cos, sin)) rotates each K block inside the
+    ppermute schedule at its owner's reconstructed zigzag positions
+    instead of materializing a pre-ring apply_rope of K.  The rotation
+    arithmetic is elementwise-identical to pre-roping (it commutes with
+    the ppermute and with chunk slicing), so the fused forward must be
+    f32-EXACT vs the unfused path — this replaces the pre-round-21
+    refusal (fuse_rope + seq>1 used to raise by name)."""
     import dataclasses
 
-    cfg = dataclasses.replace(_cfg(), fuse_rope=True)
+    cfg = _cfg()
     mesh = M.build_4d_mesh(devices)        # factor_mesh(8): seq axis 2
     if mesh.shape[M.SEQ] < 2:
-        pytest.skip("mesh has no sequence parallelism to refuse")
-    batch = M.shard_lm_batch(mesh, _batch(cfg, B=8, S=32))
-    params = M.place_params(mesh, cfg,
-                            M.init_params(cfg, jax.random.PRNGKey(0)))
-    step = M.make_megatron_eval_step(cfg, mesh)
-    with pytest.raises(ValueError, match="fuse_rope"):
-        step(params, batch["tokens"], batch["targets"], batch["mask"])
+        pytest.skip("mesh has no sequence parallelism to fuse through")
+    batch = _batch(cfg, B=8, S=32, seed=5)
+    params_host = jax.device_get(M.init_params(cfg, jax.random.PRNGKey(3)))
+
+    def forward(c):
+        params = M.place_params(mesh, c, params_host)
+        ev = M.make_megatron_eval_step(c, mesh)
+        b = M.shard_lm_batch(mesh, batch)
+        out = ev(params, b["tokens"], b["targets"], b["mask"])
+        return {k: float(v) for k, v in jax.device_get(out).items()}
+
+    ref = forward(cfg)                     # auto -> unfused on CPU
+    got = forward(dataclasses.replace(cfg, fuse_rope=True))
+    assert got["loss"] == ref["loss"], (got, ref)
+    assert got["accuracy"] == ref["accuracy"]
 
 
 def test_serve_engine_rules_requires_mesh():

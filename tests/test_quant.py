@@ -21,6 +21,15 @@ ever compiling a program (lazy program builds).
 * **program count** — still exactly three compiled program families
   (prefill-per-bucket / decode / verify-per-k); quantization is weights
   + arena layout, never a compile shape.
+
+Kernel round 2 adds the **fp8 section** at the bottom: the
+``quantize_weights='w8f'`` / ``kv_dtype='fp8'`` recipes (float8_e4m3fn
+payloads, bf16 scales) — quantizer bounds, named
+:class:`Fp8UnsupportedError` refusals at construction, byte receipts
+STRICTLY below the int8 row, and engine-vs-eager-QUANTIZED token
+identity (fp8 is lossy vs f32, so greedy can legitimately differ from
+the f32 oracle — the pin is that the engine serves exactly what its
+own quantized model computes).
 """
 
 import jax
@@ -32,8 +41,9 @@ import pytest
 from dtdl_tpu.models.transformer import transformer_lm
 from dtdl_tpu.obs import Observer
 from dtdl_tpu.quant import (
-    canon_kv_dtype, dequantize_params, kv_quantize, quantize_params,
-    quantize_tensor, tree_bytes,
+    FP8_DTYPE, Fp8UnsupportedError, canon_kv_dtype, canon_weight_quant,
+    dequantize_params, fp8_supported, kv_quantize, kv_scale_dtype,
+    quantize_params, quantize_tensor, tree_bytes, weight_dtypes,
 )
 from dtdl_tpu.serve import (
     InferenceEngine, NGramDraft, Request, SampleParams, Scheduler,
@@ -400,6 +410,213 @@ def test_three_program_families_zero_recompiles(qengine, obs):
     assert all(n == 1 for n in stats["verify"].values()), stats
     assert stats["quant"]["weights"] and \
         stats["quant"]["kv_dtype"] == "int8"
+    assert obs.sentinel.summary()["recompile_events"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fp8 (kernel round 2): 'w8f' weights + fp8 KV — same schema, new payload
+# ---------------------------------------------------------------------------
+
+needs_fp8 = pytest.mark.skipif(not fp8_supported(),
+                               reason="jax build lacks float8_e4m3fn")
+
+#: stated fp8 parity tolerance: e4m3's 3 mantissa bits round each
+#: weight within 2^-4 relative (vs int8's ~1/254), so the fp8 logit
+#: budget is 3x the int8 one — the measured drift on the tiny config
+#: is well inside it
+FP8_REL_TOL = 3 * REL_TOL
+
+
+@needs_fp8
+def test_quantize_tensor_fp8_bounds():
+    """fp8 payload + bf16 per-channel scales: reconstruct within e4m3's
+    2^-4 relative step (plus a subnormal absolute floor), never NaN —
+    the quantizer clips to ±448 BEFORE the cast (fp8 casts overflow to
+    NaN, not saturate) and divides by the bf16-ROUNDED scale so the
+    stored sidecar is exactly the dequant multiplier."""
+    gen = np.random.default_rng(3)
+    w = (gen.normal(size=(32, 8)) *
+         np.logspace(-3, 3, 8)).astype(np.float32)  # wild channel ranges
+    w[:, 5] = 0.0                                   # degenerate channel
+    q, s = quantize_tensor(w, (1, 8), dtype=FP8_DTYPE)
+    assert q.dtype == FP8_DTYPE and s.dtype == jnp.bfloat16
+    assert s.shape == (1, 8)
+    assert float(s[0, 5]) == 1.0
+    assert not np.asarray(q, np.float32)[:, 5].any()
+    recon = np.asarray(q, np.float32) * np.asarray(s, np.float32)
+    assert np.isfinite(recon).all()          # clip-before-cast, no NaN
+    err = np.abs(w - recon)
+    s32 = np.broadcast_to(np.asarray(s, np.float32), w.shape)
+    assert (err <= np.abs(w) * 2.0 ** -4 + s32 * 2.0 ** -9 + 1e-7).all()
+
+
+@needs_fp8
+def test_kv_quantize_fp8_rowwise():
+    """Per-(..., position) fp8 rows with bf16 write-once scales: same
+    layout as int8 (scale per D-row off its own max), e4m3 error
+    bound, finite everywhere."""
+    gen = np.random.default_rng(4)
+    x = (gen.normal(size=(2, 3, 5, 16)) *
+         gen.lognormal(2.0, size=(2, 3, 5, 1))).astype(np.float32)
+    q, s = kv_quantize(jnp.asarray(x), dtype=FP8_DTYPE)
+    assert q.dtype == FP8_DTYPE and s.dtype == jnp.bfloat16
+    assert q.shape == x.shape and s.shape == x.shape[:-1]
+    recon = np.asarray(q, np.float32) * np.asarray(s, np.float32)[..., None]
+    assert np.isfinite(recon).all()
+    err = np.abs(x - recon)
+    s32 = np.asarray(s, np.float32)[..., None]
+    assert (err <= np.abs(x) * 2.0 ** -4 + s32 * 2.0 ** -9 + 1e-7).all()
+
+
+@needs_fp8
+def test_canon_fp8_modes_and_dtypes():
+    assert canon_kv_dtype("fp8") == FP8_DTYPE
+    assert canon_kv_dtype(FP8_DTYPE) == FP8_DTYPE
+    assert kv_scale_dtype(None) is None
+    assert kv_scale_dtype("int8") == jnp.float32    # round-7 layout
+    assert kv_scale_dtype("fp8") == jnp.bfloat16    # 2-byte sidecar
+    assert canon_weight_quant(None) is False
+    assert canon_weight_quant("int8") is True
+    assert canon_weight_quant("w8f") == "w8f"
+    assert canon_weight_quant("fp8") == "w8f"
+    assert canon_weight_quant(FP8_DTYPE) == "w8f"
+    assert weight_dtypes(True) == (jnp.int8, jnp.float32)
+    assert weight_dtypes("w8f") == (FP8_DTYPE, jnp.bfloat16)
+    with pytest.raises(ValueError, match="quantize_weights"):
+        canon_weight_quant("w4")
+
+
+def test_fp8_unsupported_build_named_errors(monkeypatch, model, params):
+    """A jax build without float8_e4m3fn refuses fp8 BY NAME at every
+    entry point — canonicalization and engine construction — never
+    from inside a traced program."""
+    monkeypatch.setattr("dtdl_tpu.quant.core.FP8_DTYPE", None)
+    assert not fp8_supported()
+    with pytest.raises(Fp8UnsupportedError, match="float8_e4m3fn"):
+        canon_kv_dtype("fp8")
+    with pytest.raises(Fp8UnsupportedError, match="float8_e4m3fn"):
+        canon_weight_quant("w8f")
+    with pytest.raises(Fp8UnsupportedError):
+        InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                        quantize_weights="w8f")
+    with pytest.raises(Fp8UnsupportedError):
+        InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                        page_size=PAGE, kv_dtype="fp8")
+
+
+@needs_fp8
+def test_fp8_mesh_needs_named_rule_preset(model, params):
+    """fp8 weights under a mesh refuse a RAW rules sequence by name at
+    construction: the quant-aware rule map derives fp8 kernel+scale
+    specs per NAMED preset (parallel/tensor.py RULE_PRESETS)."""
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("model",))
+    with pytest.raises(Fp8UnsupportedError, match="w8f"):
+        InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                        quantize_weights="w8f", mesh=mesh,
+                        rules=(("kernel", ("model",)),))
+
+
+@needs_fp8
+def test_fp8_receipts_strictly_below_int8(model, params):
+    """The kernel-round-2 byte claim, from compile_stats: same 1-byte
+    payload as int8, but bf16 scale sidecars HALVE kv_scale_bytes and
+    shrink param_bytes — every derived byte metric lands strictly
+    below the int8 row, and a fixed paged budget holds more pages."""
+    q8 = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                         quantize_weights=True, kv_dtype="int8")
+    f8 = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                         quantize_weights="w8f", kv_dtype="fp8")
+    s8 = q8.compile_stats()["quant"]
+    sf8 = f8.compile_stats()["quant"]
+    assert sf8["weights"] == "w8f" and sf8["kv_dtype"] == "fp8"
+    assert sf8["kv_payload_bytes"] == s8["kv_payload_bytes"]  # both 1B
+    assert sf8["kv_scale_bytes"] * 2 == s8["kv_scale_bytes"]  # bf16/f32
+    assert sf8["param_bytes"] < s8["param_bytes"]
+    assert sf8["kv_arena_bytes"] < s8["kv_arena_bytes"]
+    assert sf8["decode_hbm_bytes_per_token"] < \
+        s8["decode_hbm_bytes_per_token"]
+    # paged: the SAME byte budget holds strictly more fp8 pages (the
+    # scale sidecar is half the size, the payload identical)
+    budget = 256 * 1024
+    p8 = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                         page_size=PAGE, kv_pool_bytes=budget,
+                         kv_dtype="int8")
+    pf8 = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                          page_size=PAGE, kv_pool_bytes=budget,
+                          kv_dtype="fp8")
+    assert pf8.n_pages > p8.n_pages, (p8.n_pages, pf8.n_pages)
+    assert pf8.page_bytes * pf8.n_pages <= budget
+
+
+@pytest.mark.slow
+@needs_fp8
+def test_w8f_logits_parity_eager(model, params):
+    """fp8 weight-only full forward vs f32 within the STATED fp8
+    tolerance (e4m3 rounds ~2^-4 relative per weight, so fp8 gets its
+    own looser budget); schema check: fp8 payload + bf16 scale
+    siblings on the same paths int8 quantizes."""
+    qp = quantize_params(model, params, mode="w8f")
+    blk = qp["block_0"]["attn"]["q"]
+    assert blk["kernel"].dtype == FP8_DTYPE
+    assert blk["kernel_scale"].dtype == jnp.bfloat16
+    assert qp["embed"].dtype == params["embed"].dtype   # still untouched
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    lf = model.apply({"params": params}, toks)
+    lq = model.clone(quantize="w8f").apply({"params": qp}, toks)
+    drift = float(jnp.max(jnp.abs(lf - lq)))
+    assert drift <= FP8_REL_TOL * float(jnp.max(jnp.abs(lf))), drift
+
+
+def _eager_greedy_fp8(qmodel, qp, prompt, n_new):
+    """ref_greedy on an already-quantized model with an fp8 scalar
+    cache — the fp8 engine's oracle (fp8 is LOSSY vs f32: greedy can
+    legitimately differ from the f32 decode, so the engine contract is
+    identity with its own quantized model, not with f32)."""
+    cache = qmodel.init_cache(1, kv_dtype="fp8")
+    assert cache["block_0"]["attn"]["key"].dtype == FP8_DTYPE
+    assert cache["block_0"]["attn"]["key_scale"].dtype == jnp.bfloat16
+    # first token off the DECODE-mode prefill logits (attention through
+    # the quantized cache), matching the engine — a cacheless full
+    # forward attends unquantized, and fp8 noise CAN flip its argmax
+    logits, m = qmodel.apply({"params": qp, "cache": cache},
+                             jnp.asarray([prompt], jnp.int32), decode=True,
+                             mutable=["cache"])
+    out = [int(jnp.argmax(logits[0, -1]))]
+    cache = m["cache"]
+    for _ in range(n_new - 1):
+        logits, m = qmodel.apply(
+            {"params": qp, "cache": cache},
+            jnp.asarray([[out[-1]]], jnp.int32), decode=True,
+            mutable=["cache"])
+        cache = m["cache"]
+        out.append(int(jnp.argmax(logits[0, -1])))
+    return out
+
+
+@pytest.mark.slow
+@needs_fp8
+def test_w8f_fp8_paged_engine_token_identity_vs_eager(model, params):
+    """fp8 end to end: the w8f + fp8-paged engine serves mixed
+    spec/non-spec traffic with slot reuse token-identically to ITS OWN
+    quantized model's solo eager decode over an fp8 scalar cache, with
+    zero recompiles — quantize-on-scatter into fp8 pages, bf16 scales
+    riding the page table, verify over fp8 K/V included."""
+    obs = Observer(sentinel="raise")
+    eng = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                          page_size=PAGE, observer=obs,
+                          quantize_weights="w8f", kv_dtype="fp8")
+    assert eng.compile_stats()["quant"]["weights"] == "w8f"
+    gen = np.random.default_rng(9)
+    lens = (5, 9, 12, 4)
+    n_new = (8, 6, 7, 5)
+    prompts = [gen.integers(0, 64, n).tolist() for n in lens]
+    reqs = [Request(p, n, speculate=(3 if i % 2 else 0))
+            for i, (p, n) in enumerate(zip(prompts, n_new))]
+    Scheduler(eng, harvest_lag=2, draft=NGramDraft()).run(reqs)
+    for req, prompt, n in zip(reqs, prompts, n_new):
+        assert req.done
+        want = _eager_greedy_fp8(eng.model, eng.params, prompt, n)
+        assert req.tokens == want, f"rid={req.rid} diverged on fp8"
     assert obs.sentinel.summary()["recompile_events"] == 0
 
 
