@@ -913,6 +913,123 @@ def bench_chunked_prefill(size: str = "small", n_slots: int = 4,
     }
 
 
+def bench_multitenant(n_slots: int = 4, new_tokens: int = 32,
+                      n_adapters: int = 4, rank: int = 8) -> dict:
+    """Multi-tenant serving row (round 22): the cost of tenancy.
+
+    Three questions, each against its own control through the SAME
+    scheduler on one LoRA-capable engine (adapter ids / grammar masks
+    are data, so every config below reuses ONE compiled program set):
+
+    * **multi-LoRA** — delivered tokens/sec with every request on the
+      base model, all on ONE adapter, and round-robined across N
+      adapters.  The N-adapter rate over the 1-adapter rate is the
+      batching claim: tenancy costs a bank gather, not a batch split
+      (a per-tenant engine would divide throughput by N).
+    * **grammar** — unconstrained vs JSON-schema-constrained decode.
+      The constrained run pays a host-side DFA advance per harvested
+      token and a [B, V] mask upload per step, both off the device's
+      critical path; the ratio prices them.
+    * **streaming** — mean time-to-first-STREAMED-token beside the
+      engine TTFT: the stream delivers at the first lag-harvest
+      boundary, so the gap is ~harvest_lag steps, not a new sync.
+    """
+    import os
+    import tempfile
+
+    import flax.linen as nn
+    from dtdl_tpu.ckpt import save_weights
+    from dtdl_tpu.models import transformer_lm
+    from dtdl_tpu.serve import (InferenceEngine, Request, Scheduler,
+                                TokenStream, adapter_template, byte_vocab,
+                                compile_json_schema)
+
+    model = transformer_lm("tiny", attn_impl="dense", dtype=jnp.float32)
+    params = nn.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    rng = np.random.default_rng(0)
+    tmp = tempfile.mkdtemp(prefix="bench_lora_")
+    tpl = adapter_template(params, rank=rank)
+    paths = []
+    for i in range(n_adapters):
+        tree = jax.tree_util.tree_map(
+            lambda x: np.asarray(rng.normal(0, 0.02, x.shape),
+                                 np.float32), tpl)
+        p = os.path.join(tmp, f"tenant_{i}")
+        save_weights(p, tree)
+        paths.append(p)
+    engine = InferenceEngine(model, params, n_slots=n_slots,
+                             lora_rank=rank,
+                             lora_adapters=n_adapters + 1)
+    prompts = [rng.integers(0, model.vocab_size, int(n)).tolist()
+               for n in rng.integers(8, 16, 2 * n_slots)]
+    eos = model.vocab_size - 1
+    dfa = compile_json_schema(
+        {"type": "object",
+         "properties": {"a": {"type": "integer"},
+                        "b": {"type": "string"}},
+         "required": ["a", "b"]},
+        byte_vocab(model.vocab_size), eos_id=eos)
+
+    def run(tenants=(None,), grammar=None, stream=False):
+        first_cb = {}
+
+        def mk_stream(i):
+            if not stream:
+                return None
+            return TokenStream(callback=lambda new, i=i: first_cb
+                               .setdefault(i, time.perf_counter()))
+
+        reqs = [Request(list(p), new_tokens,
+                        adapter=tenants[i % len(tenants)],
+                        grammar=grammar,
+                        eos_id=(eos if grammar is not None else None),
+                        stream=mk_stream(i))
+                for i, p in enumerate(prompts)]
+        t0 = {r.rid: time.perf_counter() for r in reqs}
+        sched = Scheduler(engine, harvest_lag=2)
+        sched.run(reqs)
+        s = sched.metrics.summary()
+        if stream:
+            gaps = [first_cb[i] - t0[r.rid]
+                    for i, r in enumerate(reqs) if i in first_cb]
+            s["ttfst_s_mean"] = round(float(np.mean(gaps)), 6) \
+                if gaps else None
+        return s
+
+    run()                                       # warmup: compile + bank
+    base = run()
+    one = run(tenants=(paths[0],))
+    many = run(tenants=[None] + paths)
+    con = run(grammar=dfa)
+    strm = run(stream=True)
+    tps = "decode_tokens_per_sec"
+    return {
+        "model": "multitenant", "n_slots": n_slots,
+        "n_adapters": n_adapters, "rank": rank,
+        "lora": {
+            "base_tokens_per_sec": base[tps],
+            "one_adapter_tokens_per_sec": one[tps],
+            "n_adapters_tokens_per_sec": many[tps],
+            "bank_loads": engine.adapter_bank.n_loads,
+            "tokens_by_adapter": many["tokens_by_adapter"],
+        },
+        "grammar": {
+            "free_tokens_per_sec": base[tps],
+            "constrained_tokens_per_sec": con[tps],
+            "grammar_rejected_tokens": con["grammar_rejected_tokens"],
+            "dfa_states": dfa.n_states,
+            "dfa_bytes": dfa.nbytes(),
+        },
+        "stream": {
+            "ttft_s_mean": strm["ttft_s_mean"],
+            "ttfst_s_mean": strm["ttfst_s_mean"],
+            "stream_deliveries": strm["stream_deliveries"],
+        },
+        "compiled_decode_programs": engine.compile_stats()["decode"],
+    }
+
+
 def bench_quant(model, params, n_slots: int = 4, page_size: int = 32,
                 new_tokens: int = 48) -> list:
     """Quantized-serving sweep: f32 / w8 / w8+kv8 / w8f+kvf8 ×
@@ -1748,6 +1865,10 @@ def main(argv=None) -> dict:
     p.add_argument("--skip-observability", action="store_true",
                    help="skip the observability-overhead (tracer on vs "
                         "off steps/sec) row")
+    p.add_argument("--skip-multitenant", action="store_true",
+                   help="skip the multi-tenant serving row (batched "
+                        "multi-LoRA, grammar-constrained decode, token "
+                        "streaming — round 22)")
     p.add_argument("--skip-elastic", action="store_true",
                    help="skip the elastic-training row (kill-one-of-N "
                         "MTTR drill + liveness-layer overhead)")
@@ -1968,6 +2089,19 @@ def main(argv=None) -> dict:
                            "error": f"{type(e).__name__}: {e}"[:200]}
         records.append(chunked_row)
         print("  " + json.dumps(chunked_row), file=sys.stderr, flush=True)
+
+    mt_row = None
+    if not a.skip_multitenant:
+        # multi-tenant row (round 22): N-adapter batching vs 1-adapter
+        # vs base, grammar-constrained vs free decode, and the
+        # streaming first-token gap
+        try:
+            mt_row = bench_multitenant()
+        except Exception as e:  # must never sink the bench
+            mt_row = {"model": "multitenant",
+                      "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(mt_row)
+        print("  " + json.dumps(mt_row), file=sys.stderr, flush=True)
 
     elastic_row = None
     if not a.skip_elastic:
@@ -2238,6 +2372,28 @@ def main(argv=None) -> dict:
             dis.get("kv_handoff_pages")
         summary["fleet_disagg_kv_handoff_s_mean"] = \
             dis.get("kv_handoff_s_mean")
+
+    if mt_row and "error" not in mt_row:
+        # multi-tenant receipts (round 22): N-adapter batching keeps
+        # throughput (the non-split-batch claim), constrained decode's
+        # host-mask tax, and the streamed-first-token gap
+        lo, gr, st = mt_row["lora"], mt_row["grammar"], mt_row["stream"]
+        summary["serve_lora_tokens_per_sec"] = \
+            lo["n_adapters_tokens_per_sec"]
+        summary["serve_lora_vs_base"] = round(
+            lo["n_adapters_tokens_per_sec"] / lo["base_tokens_per_sec"],
+            3) if lo["base_tokens_per_sec"] else None
+        summary["serve_lora_vs_one_adapter"] = round(
+            lo["n_adapters_tokens_per_sec"]
+            / lo["one_adapter_tokens_per_sec"], 3) \
+            if lo["one_adapter_tokens_per_sec"] else None
+        summary["serve_grammar_tokens_per_sec"] = \
+            gr["constrained_tokens_per_sec"]
+        summary["serve_grammar_vs_free"] = round(
+            gr["constrained_tokens_per_sec"] / gr["free_tokens_per_sec"],
+            3) if gr["free_tokens_per_sec"] else None
+        summary["serve_stream_ttfst_s"] = st["ttfst_s_mean"]
+        summary["serve_stream_ttft_s"] = st["ttft_s_mean"]
 
     if elastic_row and "error" not in elastic_row:
         dr = elastic_row.get("drill") or {}

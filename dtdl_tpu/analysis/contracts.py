@@ -38,12 +38,12 @@ from dtdl_tpu.analysis.jaxpr_audit import audit_jaxpr
 
 #: program name -> builder; the contract surface of this module
 PROGRAMS = ("train_step", "megatron_step", "serve_decode",
-            "serve_verify")
+            "serve_verify", "serve_lora_decode")
 
 #: devices each pinned geometry needs (train_step adapts to the local
 #: mesh; the 4D megatron step is pinned at its (1, 1, 2, 4) mesh)
 MIN_DEVICES = {"train_step": 1, "megatron_step": 8, "serve_decode": 1,
-               "serve_verify": 1}
+               "serve_verify": 1, "serve_lora_decode": 1}
 
 
 def runnable_programs(names=PROGRAMS) -> tuple[list, list]:
@@ -149,7 +149,9 @@ def _build_serve_decode():
     fn = eng._build_decode()
     args = (eng.params, eng.init_arena(), eng.init_last_tokens(),
             jnp.ones((eng.n_slots,), bool), jnp.zeros((), jnp.int32),
-            jax.random.PRNGKey(0), *pack([SampleParams()] * eng.n_slots))
+            jax.random.PRNGKey(0), *pack([SampleParams()] * eng.n_slots),
+            jnp.ones((eng.n_slots, 64), bool),
+            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
     return fn, args, (1,)
 
 
@@ -167,14 +169,44 @@ def _build_serve_verify():
             jnp.ones((B,), bool), jnp.zeros((B,), bool),
             jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
             jnp.zeros((), jnp.int32), jax.random.PRNGKey(0),
-            *pack([SampleParams()] * B))
+            *pack([SampleParams()] * B),
+            jnp.ones((B, k + 1, 64), bool),
+            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+    return fn, args, (1,)
+
+
+def _build_serve_lora_decode():
+    """The decode program of a multi-LoRA engine (round 22): the bank
+    gather must add no collectives and no host transfers — adapter ids
+    and the bank itself ride in as data."""
+    import flax.linen as nn
+
+    from dtdl_tpu.models.transformer import transformer_lm
+    from dtdl_tpu.serve.engine import InferenceEngine
+    from dtdl_tpu.serve.sampling import SampleParams, pack
+
+    model = transformer_lm("tiny", vocab_size=64, d_model=32,
+                           n_layers=2, n_heads=2, d_ff=64, max_seq=32,
+                           attn_impl="dense", dtype=jnp.float32)
+    params = nn.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"])
+    eng = InferenceEngine(model, params, n_slots=2, buckets=(8,),
+                          lora_rank=2, lora_adapters=2)
+    fn = eng._build_decode()
+    B = eng.n_slots
+    args = (eng.params, eng.init_arena(), eng.init_last_tokens(),
+            jnp.ones((B,), bool), jnp.zeros((), jnp.int32),
+            jax.random.PRNGKey(0), *pack([SampleParams()] * B),
+            jnp.ones((B, 64), bool),
+            jnp.zeros((B,), jnp.int32), eng.adapter_bank.bank)
     return fn, args, (1,)
 
 
 _BUILDERS = {"train_step": _build_train_step,
              "megatron_step": _build_megatron_step,
              "serve_decode": _build_serve_decode,
-             "serve_verify": _build_serve_verify}
+             "serve_verify": _build_serve_verify,
+             "serve_lora_decode": _build_serve_lora_decode}
 
 
 # ---------------------------------------------------------------------------

@@ -128,6 +128,25 @@ class Attention(nn.Module):
         q = proj("q")(x)
         k = proj("k")(x)
         v = proj("v")(x)
+        # batched multi-LoRA (round 22): when the engine passes a 'lora'
+        # collection, every projection gains a low-rank delta gathered
+        # from the adapter bank by each row's adapter id — per-slot DATA
+        # (dtdl_tpu/serve/tenant/lora.py), so one compiled step serves a
+        # mixed-adapter batch.  Absent during the init trace and for
+        # engines without a bank: params and programs are unchanged.
+        lora = self.has_variable("lora", "q_a")
+        if lora:
+            aid = self.get_variable("lora", "aid")           # [B] int32
+
+            def lo_delta(name, h):
+                a = jnp.take(self.get_variable("lora", f"{name}_a"),
+                             aid, axis=0)                    # [B, d, r]
+                bb = jnp.take(self.get_variable("lora", f"{name}_b"),
+                              aid, axis=0)                   # [B, r, H, D]
+                t = jnp.einsum("bsd,bdr->bsr", x.astype(a.dtype), a)
+                return h + jnp.einsum("bsr,brhe->bshe", t,
+                                      bb).astype(h.dtype)
+            q, k, v = lo_delta("q", q), lo_delta("k", k), lo_delta("v", v)
         # [B, S, H, D] -> [B, H, S, D]
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         if decode:
@@ -144,14 +163,24 @@ class Attention(nn.Module):
             o = mha_reference(q, k, v, causal=True).astype(self.dtype)
         o = o.transpose(0, 2, 1, 3)
         if self.quantize:
-            return QuantDenseGeneral(
+            out = QuantDenseGeneral(
                 features=d_model, axis=(-2, -1), dtype=self.dtype,
                 mode=self.quantize, name="out")(o)
-        return nn.DenseGeneral(
-            features=d_model, axis=(-2, -1), use_bias=False, dtype=self.dtype,
-            kernel_init=_part(nn.initializers.lecun_normal(),
-                              "heads", "head_dim", "embed"),
-            name="out")(o)
+        else:
+            out = nn.DenseGeneral(
+                features=d_model, axis=(-2, -1), use_bias=False,
+                dtype=self.dtype,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  "heads", "head_dim", "embed"),
+                name="out")(o)
+        if lora:
+            a = jnp.take(self.get_variable("lora", "out_a"),
+                         aid, axis=0)                        # [B, H, D, r]
+            bb = jnp.take(self.get_variable("lora", "out_b"),
+                          aid, axis=0)                       # [B, r, d]
+            t = jnp.einsum("bshe,bher->bsr", o.astype(a.dtype), a)
+            out = out + jnp.einsum("bsr,brd->bsd", t, bb).astype(out.dtype)
+        return out
 
     # prefill query rows are processed in blocks of this many: peak
     # attention memory stays O(chunk * max_seq) instead of the

@@ -131,6 +131,12 @@ EVENT_CATALOG = frozenset({
     # server side
     "store_reconnect", "store_torn_frame", "store_epoch_refused",
     "store_wal_recovered",
+    # multi-tenant serving (round 22): LoRA adapter-bank residency
+    # edges, grammar-constraint outcomes (reason=illegal is a contained
+    # failure, reason=incomplete a budget truncation mid-structure),
+    # and incremental TokenStream deliveries at harvest boundaries
+    "adapter_loaded", "adapter_evicted", "grammar_violation",
+    "stream_delivery",
 })
 
 

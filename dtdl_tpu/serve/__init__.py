@@ -35,12 +35,27 @@ token-identical by determinism):
     done = router.run([Request(p, 32) for p in prompts])
     router.shutdown()                           # or `with Router(...)`
 
+**Multi-tenant serving** (round 22, serve/tenant/) rides the same slot
+machinery: per-slot LoRA adapters batched inside ONE compiled step
+(adapter ids are data gathered from a device-resident bank — no
+per-tenant programs), grammar-constrained decoding via token-level DFAs
+folded into the sampler as per-slot masks, and incremental token
+streaming at the lag-harvest boundaries:
+
+    engine = InferenceEngine(model, params, n_slots=8,
+                             lora_rank=8, lora_adapters=4)
+    dfa = compile_json_schema(schema, vocab, eos_id=eos)
+    sched.submit(Request(p, 64, adapter="ckpts/tenant_a",
+                         grammar=dfa, eos_id=eos,
+                         stream=TokenStream()))
+
 See engine.py (the compiled-program contract), scheduler.py (slot-based
 continuous batching + spec integration), paged.py (page allocator +
 radix-style prefix cache), draft.py (draft sources), sampling.py
 (per-slot greedy/temperature/top-k/top-p + the accept/resample kernel),
 metrics.py (async serving telemetry), health.py (the per-replica state
-machine), fleet.py (the Router/Replica fleet layer).
+machine), fleet.py (the Router/Replica fleet layer), tenant/ (batched
+multi-LoRA, grammar DFAs, token streams).
 """
 
 from dtdl_tpu.serve.draft import (  # noqa: F401
@@ -66,3 +81,8 @@ from dtdl_tpu.serve.sampling import (  # noqa: F401
     filter_logits_sorted, sample,
 )
 from dtdl_tpu.serve.scheduler import Request, Scheduler  # noqa: F401
+from dtdl_tpu.serve.tenant import (  # noqa: F401
+    AdapterBank, AdapterBankFullError, TokenDFA, TokenStream,
+    adapter_template, byte_vocab, compile_json_schema, compile_regex,
+    json_schema_to_regex, merge_adapter,
+)

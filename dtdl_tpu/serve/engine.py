@@ -156,6 +156,21 @@ def _strip_paged(cache):
     return conv(cache)
 
 
+def _lora_vars(bank, aids):
+    """Insert the per-call adapter-id vector into every attention node
+    of the LoRA bank tree — the 'lora' collection leaf
+    :class:`~dtdl_tpu.models.transformer.Attention` gathers its
+    adapter rows by (round 22).  Same per-call-data pattern as
+    :func:`_paged_cache`: adapter ids are inputs, never shapes."""
+    def conv(tree):
+        if isinstance(tree, dict):
+            if "q_a" in tree:
+                return dict(tree, aid=aids)
+            return {k: conv(v) for k, v in tree.items()}
+        return tree
+    return conv(bank)
+
+
 class InferenceEngine:
     """Compiled prefill/decode pair over a slotted KV arena (see module
     docstring).  ``n_slots`` is the decode batch width — the one shape
@@ -208,9 +223,18 @@ class InferenceEngine:
                  n_pages: int | None = None,
                  quantize_weights=False, kv_dtype=None,
                  kv_pool_bytes: int | None = None, paged_kernel="auto",
-                 mesh=None, rules="tp"):
+                 mesh=None, rules="tp", lora_rank: int = 0,
+                 lora_adapters: int = 0):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if lora_rank < 0 or lora_adapters < 0:
+            raise ValueError("lora_rank/lora_adapters must be >= 0")
+        if bool(lora_rank) != bool(lora_adapters):
+            raise ValueError("pass lora_rank AND lora_adapters together "
+                             "(both 0 disables the adapter bank)")
+        if lora_adapters == 1:
+            raise ValueError("lora_adapters must be >= 2: row 0 is the "
+                             "reserved all-zeros base adapter")
         # canonicalization raises the NAMED fp8 errors here, at
         # construction (Fp8UnsupportedError on builds without
         # float8_e4m3fn), never from inside a traced program
@@ -282,6 +306,39 @@ class InferenceEngine:
                     jnp.zeros((1, 1), jnp.int32))["params"]
                 param_sh = logical_shardings(mesh, abs_boxed, rules)
             self.params = jax.device_put(self.params, param_sh)
+        # batched multi-LoRA (round 22): a device-resident adapter bank
+        # whose rows per-slot int32 ids gather INSIDE the compiled
+        # steps (models/transformer.py) — adapter identity is data, so
+        # a mixed-adapter batch rides the same three program families.
+        # Row 0 stays all-zeros (the base model); the host registry
+        # hot-loads/evicts rows through the manifest-integrity
+        # checkpoint path (dtdl_tpu/serve/tenant/lora.py).
+        self.lora_rank = lora_rank
+        self.lora_adapters = lora_adapters
+        self.adapter_bank = None
+        if lora_rank:
+            from dtdl_tpu.serve.tenant.lora import (AdapterBank,
+                                                    adapter_template,
+                                                    bank_pspecs,
+                                                    init_bank)
+            bank = init_bank(self.params, lora_rank, lora_adapters)
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                bank = jax.tree.map(
+                    lambda l, s: jax.device_put(
+                        l, NamedSharding(mesh, s)),
+                    bank, bank_pspecs(bank))
+            self.adapter_bank = AdapterBank(
+                bank, adapter_template(self.params, lora_rank),
+                observer=observer)
+        # neutral per-call tenant inputs, allocated once: the all-zeros
+        # adapter-id vector and all-true grammar masks keep every
+        # unconstrained dispatch bit-identical to the pre-tenant
+        # programs WITHOUT re-uploading [B(, k+1), V] arrays per step
+        self._zero_aids = jnp.zeros((n_slots,), jnp.int32)
+        self._ones_decode = jnp.ones((n_slots, model.vocab_size), bool)
+        self._ones_prefill = jnp.ones((1, model.vocab_size), bool)
+        self._ones_verify: dict[int, object] = {}
         # obs facade: when set (directly or by the Scheduler), the
         # recompile sentinel wraps each compiled program — a retrace of
         # the decode program or a re-trace of an already-built prefill
@@ -401,13 +458,17 @@ class InferenceEngine:
 
     def _build_prefill(self, T: int):
         model, cache1 = self.model, self._cache1
+        use_lora = self.lora_rank > 0
 
         def prefill(params, arena, last, tokens, length, slot, key,
-                    temp, top_k, top_p):
+                    temp, top_k, top_p, allowed, aid, lora):
             cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                                  cache1)
+            variables = {"params": params, "cache": cache}
+            if use_lora:
+                variables["lora"] = _lora_vars(lora, aid[None])
             hidden, muts = model.apply(
-                {"params": params, "cache": cache}, tokens, decode=True,
+                variables, tokens, decode=True,
                 return_hidden=True, mutable=["cache"])
             # logits of the last REAL position only (pad rows beyond
             # `length` never touch the head)
@@ -416,7 +477,8 @@ class InferenceEngine:
             logits = jnp.einsum(
                 "bd,vd->bv", h_last,
                 params["embed"].astype(model.dtype)).astype(jnp.float32)
-            tok = sample(logits, key, temp, top_k, top_p)      # [1]
+            tok = sample(logits, key, temp, top_k, top_p,
+                         allowed=allowed)                      # [1]
 
             def write(a, n):
                 if n.ndim == 0:   # index leaf: the true prompt length,
@@ -434,9 +496,11 @@ class InferenceEngine:
 
     def _build_prefill_paged(self, T: int):
         model = self.model
+        use_lora = self.lora_rank > 0
 
         def prefill(params, arena, last, tokens, length, slot, start,
-                    page_row, key, temp, top_k, top_p):
+                    page_row, key, temp, top_k, top_p, allowed, aid,
+                    lora):
             # a single-row paged view over the SHARED (donated) pool:
             # the slot's table row, index at `start` (= the number of
             # prefix-cached tokens already resident in shared pages) —
@@ -446,8 +510,11 @@ class InferenceEngine:
             cache = _paged_cache(arena, page_row[None],
                                  jnp.ones((1,), bool),
                                  index=start[None])
+            variables = {"params": params, "cache": cache}
+            if use_lora:
+                variables["lora"] = _lora_vars(lora, aid[None])
             hidden, muts = model.apply(
-                {"params": params, "cache": cache}, tokens, decode=True,
+                variables, tokens, decode=True,
                 return_hidden=True, mutable=["cache"])
             # logits of the last REAL suffix position only
             h_last = jax.lax.dynamic_slice_in_dim(
@@ -455,7 +522,8 @@ class InferenceEngine:
             logits = jnp.einsum(
                 "bd,vd->bv", h_last,
                 params["embed"].astype(model.dtype)).astype(jnp.float32)
-            tok = sample(logits, key, temp, top_k, top_p)      # [1]
+            tok = sample(logits, key, temp, top_k, top_p,
+                         allowed=allowed)                      # [1]
             new_cache = _strip_paged(muts["cache"])
 
             def write(a, n):
@@ -472,13 +540,17 @@ class InferenceEngine:
 
     def _build_decode(self):
         model, paged = self.model, self.paged
+        use_lora = self.lora_rank > 0
 
         def decode(params, arena, last, active, tables, key, temp,
-                   top_k, top_p):
+                   top_k, top_p, allowed, aids, lora):
             cache = (_paged_cache(arena, tables, active) if paged
                      else arena)
+            variables = {"params": params, "cache": cache}
+            if use_lora:
+                variables["lora"] = _lora_vars(lora, aids)
             logits, muts = model.apply(
-                {"params": params, "cache": cache}, last[:, None],
+                variables, last[:, None],
                 decode=True, mutable=["cache"])
             new_cache = (_strip_paged(muts["cache"]) if paged
                          else muts["cache"])
@@ -491,7 +563,7 @@ class InferenceEngine:
             # to the garbage page inside the model, never a live page)
 
             lg = logits[:, 0].astype(jnp.float32)              # [B, V]
-            tok = sample(lg, key, temp, top_k, top_p)
+            tok = sample(lg, key, temp, top_k, top_p, allowed=allowed)
             last = jnp.where(active, tok, last)
             return arena, last, lg
 
@@ -499,10 +571,11 @@ class InferenceEngine:
 
     def _build_verify(self, k: int):
         model, paged = self.model, self.paged
+        use_lora = self.lora_rank > 0
 
         def verify(params, arena, last, draft, draft_len, active,
                    forced, first_tok, pos_set, tables, key, temp,
-                   top_k, top_p):
+                   top_k, top_p, allowed, aids, lora):
             # the slots' pre-step cache positions: every block's index
             # leaf carries the same per-slot values, take the first.
             # Chunked-prefill rows (forced) take their position from
@@ -519,14 +592,17 @@ class InferenceEngine:
             # speculative ones — same program, per-slot data)
             x0 = jnp.where(forced, first_tok, last)
             x = jnp.concatenate([x0[:, None], draft], axis=1)  # [B,k+1]
+            variables = {"params": params, "cache": cache}
+            if use_lora:
+                variables["lora"] = _lora_vars(lora, aids)
             logits, muts = model.apply(
-                {"params": params, "cache": cache}, x, decode=True,
+                variables, x, decode=True,
                 mutable=["cache"])
             new_cache = (_strip_paged(muts["cache"]) if paged
                          else muts["cache"])
             tokens, n_acc = accept_resample(
                 logits.astype(jnp.float32), draft, draft_len, key,
-                temp, top_k, top_p, forced=forced)
+                temp, top_k, top_p, forced=forced, allowed=allowed)
             n_em = n_acc + 1
 
             def fix(old, new):
@@ -645,6 +721,15 @@ class InferenceEngine:
                            "pages_per_slot": self.n_ptab,
                            "page_bytes": self.page_bytes}
                           if self.paged else None),
+                # multi-LoRA geometry (round 22): constant config — the
+                # bank is a fixed [n_adapters, ...] allocation whatever
+                # the load/evict traffic, and adapter ids are data, so
+                # a LoRA engine's program counts above are unchanged
+                "lora": ({"rank": self.lora_rank,
+                          "n_adapters": self.lora_adapters,
+                          "bank_bytes": tree_bytes(
+                              self.adapter_bank.bank)}
+                         if self.lora_rank else None),
                 "quant": {
                     "weights": self.quantized_weights,
                     "kv_dtype": (None if self.kv_dtype is None
@@ -662,9 +747,26 @@ class InferenceEngine:
 
     # ---- the two entry points ----------------------------------------
 
+    def _lora_args(self, adapter_ids, scalar: bool = False):
+        """Normalize the per-call adapter ids + bank pair: the cached
+        zero vector (base adapter everywhere) and the live bank tree
+        for LoRA engines; unused scalar placeholders otherwise."""
+        if self.lora_rank:
+            if adapter_ids is None:
+                aids = (jnp.zeros((), jnp.int32) if scalar
+                        else self._zero_aids)
+            else:
+                aids = jnp.asarray(adapter_ids, jnp.int32)
+            return aids, self.adapter_bank.bank
+        if adapter_ids is not None:
+            raise ValueError("adapter ids require an adapter bank "
+                             "(lora_rank/lora_adapters > 0)")
+        return jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)
+
     def prefill(self, arena, last_tokens, slot: int, prompt,
                 sampling: SampleParams = SampleParams(), key=None,
-                page_row=None, start: int = 0):
+                page_row=None, start: int = 0, adapter_id=None,
+                allowed=None):
         """Admit ``prompt`` into arena row ``slot``; returns the updated
         ``(arena, last_tokens, logits[V])`` — ``last_tokens[slot]`` is
         the request's first sampled token.
@@ -726,18 +828,22 @@ class InferenceEngine:
         padded = np.zeros((1, T), np.int32)
         padded[0, :prompt.size] = prompt
         key = jax.random.PRNGKey(0) if key is None else key
+        aid, lora = self._lora_args(adapter_id, scalar=True)
+        allowed = (self._ones_prefill if allowed is None
+                   else jnp.asarray(allowed, bool))
         if self.paged:
             arena, last, logits = self._prefill_fns[T](
                 self.params, arena, last_tokens, jnp.asarray(padded),
                 jnp.asarray(prompt.size, jnp.int32),
                 jnp.asarray(slot, jnp.int32),
                 jnp.asarray(start, jnp.int32), jnp.asarray(page_row),
-                key, *pack([sampling]))
+                key, *pack([sampling]), allowed, aid, lora)
         else:
             arena, last, logits = self._prefill_fns[T](
                 self.params, arena, last_tokens, jnp.asarray(padded),
                 jnp.asarray(prompt.size, jnp.int32),
-                jnp.asarray(slot, jnp.int32), key, *pack([sampling]))
+                jnp.asarray(slot, jnp.int32), key, *pack([sampling]),
+                allowed, aid, lora)
         return arena, last, logits
 
     def _tables_arg(self, page_tables):
@@ -758,7 +864,7 @@ class InferenceEngine:
         return page_tables
 
     def decode(self, arena, last_tokens, active, key, temp, top_k,
-               top_p, page_tables=None):
+               top_p, page_tables=None, adapter_ids=None, allowed=None):
         """One token for every active slot; ``active`` is a [n_slots]
         bool mask (a runtime value — occupancy never recompiles).
         Paged engines additionally take the [n_slots, pages_per_slot]
@@ -770,14 +876,18 @@ class InferenceEngine:
             if self.observer is not None:
                 fn = self.observer.watch(fn, "serve.decode")
             self._decode_fn = fn
+        aids, lora = self._lora_args(adapter_ids)
+        allowed = (self._ones_decode if allowed is None
+                   else jnp.asarray(allowed, bool))
         return self._decode_fn(self.params, arena, last_tokens,
                                jnp.asarray(active),
                                self._tables_arg(page_tables), key,
-                               temp, top_k, top_p)
+                               temp, top_k, top_p, allowed, aids, lora)
 
     def verify(self, arena, last_tokens, draft_tokens, draft_len, active,
                key, temp, top_k, top_p, page_tables=None, forced=None,
-               first_tok=None, pos_set=None):
+               first_tok=None, pos_set=None, adapter_ids=None,
+               allowed=None):
         """One speculative verify pass over every slot: score each slot's
         ``draft_len[b]`` candidate tokens (``draft_tokens[b, :]``, zero-
         padded to the program's width k) in one parameter sweep, accept a
@@ -833,11 +943,20 @@ class InferenceEngine:
             if self.observer is not None:
                 fn = self.observer.watch(fn, f"serve.verify[{k}]")
             self._verify_fns[k] = fn
+        aids, lora = self._lora_args(adapter_ids)
+        if allowed is None:
+            if k not in self._ones_verify:
+                self._ones_verify[k] = jnp.ones(
+                    (B, k + 1, self.model.vocab_size), bool)
+            allowed = self._ones_verify[k]
+        else:
+            allowed = jnp.asarray(allowed, bool)
         return self._verify_fns[k](
             self.params, arena, last_tokens, draft_tokens,
             jnp.asarray(draft_len, jnp.int32), jnp.asarray(active),
             forced, first_tok, pos_set,
-            self._tables_arg(page_tables), key, temp, top_k, top_p)
+            self._tables_arg(page_tables), key, temp, top_k, top_p,
+            allowed, aids, lora)
 
     # ---- prefill/decode disaggregation: page-granular KV handoff ------
 

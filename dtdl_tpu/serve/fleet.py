@@ -323,6 +323,16 @@ class Replica:
             self._on_complete()
 
 
+def _merge_counts(dicts) -> dict:
+    """Key-wise sum of count dicts (per-tenant rollups across
+    replicas)."""
+    out: dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
 class FleetMetrics:
     """Router-level accounting plus fleet-wide tails.
 
@@ -464,6 +474,15 @@ class FleetMetrics:
             "fleet_decode_tokens": decode_tokens,
             "fleet_decode_tokens_per_sec": round(decode_tokens / wall, 2)
             if wall > 0 else 0.0,
+            # multi-tenant rollups (round 22): per-tenant goodput and
+            # the constrained-decode / streaming ledgers, summed over
+            # replica books exactly like fleet_decode_tokens
+            "fleet_tokens_by_adapter": _merge_counts(
+                r.get("tokens_by_adapter", {}) for r in replicas),
+            "fleet_grammar_rejected_tokens": sum(
+                r.get("grammar_rejected_tokens", 0) for r in replicas),
+            "fleet_stream_deliveries": sum(
+                r.get("stream_deliveries", 0) for r in replicas),
             # the mean keys stay present under zero traffic (same
             # empty-case contract as ServeMetrics.summary); recorded
             # samples overwrite them via the histogram merges below
@@ -482,7 +501,8 @@ class FleetMetrics:
         "fleet_retries", "fleet_hedges", "fleet_hedges_won",
         "fleet_evictions", "fleet_failovers", "fleet_restarts",
         "fleet_migrations", "fleet_kv_handoff_pages",
-        "fleet_decode_tokens",
+        "fleet_decode_tokens", "fleet_tokens_by_adapter",
+        "fleet_grammar_rejected_tokens", "fleet_stream_deliveries",
     })
 
     def window(self, replicas: Sequence[dict] = (),
@@ -651,11 +671,11 @@ class Router:
                         f"replica {i} has role {r!r} in a fleet that "
                         f"migrates KV (page-granular handoff) but a "
                         f"dense engine: build it with page_size > 0")
-            if hedge_after_s is not None:
-                raise ValueError(
-                    "hedging does not compose with a role fleet yet: "
-                    "a hedged prefill flight would race two handoff "
-                    "payloads for one migration")
+            # hedging DOES compose with a role fleet (round 22): only
+            # whole flights on mixed replicas hedge — a flight that is
+            # mid-migration (or staged prefill/decode at all) is never
+            # hedged, so two handoff payloads can never race for one
+            # migration (see _hedge's stage/handoff guards)
         self.roles = roles
         self.observer = observer or NULL_OBSERVER
         self.metrics = metrics or FleetMetrics()
@@ -850,6 +870,12 @@ class Router:
                 user.done = True
                 user.t_done = time.perf_counter()
                 hook()
+            if user.stream is not None:
+                # the Router owns the USER-level stream terminal:
+                # reconcile the winning attempt's tokens (prefix-
+                # guarded — a divergent loser could never have gotten
+                # here) and close; error terminals close undelivered
+                user.stream.finish(user.tokens, user.error)
             self._flights.pop(user.rid, None)
             losers = list(fl.live.items())
             fl.live.clear()
@@ -1191,7 +1217,8 @@ class Router:
         return self.roles[i] in ("decode", "mixed")
 
     def _pick(self, exclude: Optional[int] = None,
-              stage: Optional[str] = None) -> Optional[int]:
+              stage: Optional[str] = None,
+              whole: bool = False) -> Optional[int]:
         """Least-loaded over dispatchable (HEALTHY) replicas WITH
         CAPACITY — the circuit breaker and lifecycle states are
         excluded (the never-dispatch-to-SUSPECT/EVICTED/DRAINING
@@ -1201,10 +1228,16 @@ class Router:
         where ``max_queue`` can actually shed it (eagerly draining the
         queue into replica inboxes would make the bounded-admission
         contract a no-op).  ``exclude`` lets the hedge path require a
-        DIFFERENT replica; ``stage`` applies the role filter."""
+        DIFFERENT replica; ``stage`` applies the role filter;
+        ``whole`` (round 22) restricts a role fleet to MIXED replicas
+        — the hedge path needs a replica that runs the flight end to
+        end, since a prefill-role hedge would emit a second handoff
+        payload and race the primary's migration."""
         cands = [i for i, h in enumerate(self.health)
                  if h.dispatchable and i != exclude
                  and self._role_ok(i, stage)
+                 and (not whole or self.roles is None
+                      or self.roles[i] == "mixed")
                  and self.replicas[i].load
                  < 2 * self.replicas[i].engine.n_slots]
         if not cands:
@@ -1298,7 +1331,14 @@ class Router:
                        sampling=user.sampling, eos_id=user.eos_id,
                        speculate=user.speculate,
                        deadline_at=user.deadline_at,
-                       origin_rid=user.rid, lineage=lineage)
+                       origin_rid=user.rid, lineage=lineage,
+                       # multi-tenant fields ride every attempt: the
+                       # adapter/grammar re-apply per replica, and the
+                       # SHARED TokenStream's ownership protocol keeps
+                       # sibling attempts prefix-stable (first offerer
+                       # owns; an error terminal releases the claim)
+                       adapter=user.adapter, grammar=user.grammar,
+                       stream=user.stream)
 
     def _hedge(self) -> None:
         if self.hedge_after_s is None:
@@ -1313,7 +1353,17 @@ class Router:
                 _, first_rep, t_disp = fl.attempts[-1]
                 if now - t_disp < self.hedge_after_s:
                     continue
-                j = self._pick(exclude=first_rep)
+                if self.roles is not None:
+                    # role fleets hedge ONLY single-stage flights whose
+                    # primary runs whole on a MIXED replica: a staged
+                    # flight (prefill-role primary, or a migration
+                    # already carrying a handoff payload) would race
+                    # two handoff payloads for one migration — the
+                    # composition the old constructor refused outright
+                    if (fl.stage != "prefill" or fl.handoff is not None
+                            or self.roles[first_rep] != "mixed"):
+                        continue
+                j = self._pick(exclude=first_rep, whole=True)
                 if j is None:
                     continue
                 att = self._clone(fl.req, "hedge")

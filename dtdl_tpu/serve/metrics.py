@@ -77,7 +77,17 @@ def _window_delta(summary: dict, counters, prev: dict) -> dict:
             out[k] = int(v)
         elif isinstance(v, (int, float)):
             out[k] = v - prev.get(k, 0) if k in counters else v
-    prev.update({k: summary[k] for k in counters if k in summary})
+        elif isinstance(v, dict) and k in counters:
+            # dict-valued counter (tokens_by_adapter, round 22):
+            # flatten to per-key scalar deltas — a series point stays
+            # flat, and each tenant gets its own series
+            for kk, vv in v.items():
+                if isinstance(vv, (int, float)):
+                    fk = f"{k}.{kk}"
+                    out[fk] = vv - prev.get(fk, 0)
+                    prev[fk] = vv
+    prev.update({k: summary[k] for k in counters
+                 if isinstance(summary.get(k), (int, float))})
     return out
 
 
@@ -133,6 +143,13 @@ class ServeMetrics:
         self.n_decode_steps_delayed = 0
         self.n_kv_handoff_pages = 0
         self.kv_handoff_s = 0.0
+        # multi-tenant serving (round 22): delivered generated tokens
+        # keyed by adapter name ("base" = no adapter), draft tokens the
+        # grammar automaton trimmed before verify, and incremental
+        # token deliveries pushed through per-request TokenStreams
+        self.tokens_by_adapter: dict[str, int] = {}
+        self.grammar_rejected_tokens = 0
+        self.stream_deliveries = 0
         self.ttft_s: list[float] = []          # exact samples, capped
         self.tok_latency_s: list[float] = []   # per-request mean, capped
         # streaming stats (fixed memory, never capped): means AND tails
@@ -242,6 +259,25 @@ class ServeMetrics:
         self.spec_drafted += drafted
         self.spec_accepted += accepted
 
+    def on_adapter_tokens(self, adapter: str, n: int):
+        """``n`` generated tokens harvested for a request served under
+        ``adapter`` (``"base"`` when none) — the per-tenant goodput
+        split of the same harvested-truth accounting as
+        :meth:`on_harvest_tokens`."""
+        self.tokens_by_adapter[adapter] = \
+            self.tokens_by_adapter.get(adapter, 0) + n
+
+    def on_grammar_reject(self, n: int):
+        """``n`` draft tokens trimmed at dispatch because the grammar
+        automaton rejects them — speculation burned against the
+        constraint (the cost half of the constrained-decode ledger)."""
+        self.grammar_rejected_tokens += n
+
+    def on_stream(self, n: int):
+        """``n`` tokens delivered incrementally through a request's
+        TokenStream at one lag-harvest boundary."""
+        self.stream_deliveries += n
+
     def on_harvest_tokens(self, n: int):
         """``n`` generated tokens delivered to a request at harvest
         (post-trim, excluding the prefill-sampled first token) — the
@@ -323,6 +359,11 @@ class ServeMetrics:
             "decode_steps_delayed_by_prefill": self.n_decode_steps_delayed,
             "kv_handoff_pages": self.n_kv_handoff_pages,
             "kv_handoff_s": round(self.kv_handoff_s, 6),
+            # multi-tenant serving (round 22): per-tenant goodput split
+            # plus the constrained-decode and streaming ledgers
+            "tokens_by_adapter": dict(self.tokens_by_adapter),
+            "grammar_rejected_tokens": self.grammar_rejected_tokens,
+            "stream_deliveries": self.stream_deliveries,
             # paged KV / prefix cache (all zeros for a dense arena):
             # hit rate is over FULL prompt pages — the unit of sharing
             "prefix_hit_rate": round(
@@ -361,7 +402,8 @@ class ServeMetrics:
         "spec_drafted_tokens", "spec_accepted_tokens", "draft_s",
         "prefill_chunks", "chunk_tokens",
         "decode_steps_delayed_by_prefill", "kv_handoff_pages",
-        "kv_handoff_s",
+        "kv_handoff_s", "tokens_by_adapter", "grammar_rejected_tokens",
+        "stream_deliveries",
     })
 
     def window(self) -> dict:

@@ -177,13 +177,23 @@ def filter_logits_sorted(logits, temperature, top_k, top_p):
     return jnp.where(keep_k & keep_p, scaled, -jnp.inf)
 
 
-def sample(logits, key, temperature, top_k, top_p):
+def sample(logits, key, temperature, top_k, top_p, allowed=None):
     """Sample one token per slot: [B, V] f32 logits -> [B] int32.
 
     ``temperature``/``top_p`` are f32 [B], ``top_k`` int32 [B] — all
     dynamic (see module docstring).  Rows whose temperature is 0 return
     the raw argmax regardless of their top-k/top-p settings.
+
+    ``allowed`` ([B, V] bool, optional) is the grammar mask of round 22
+    (dtdl_tpu/serve/tenant/grammar.py): disallowed tokens drop to -inf
+    BEFORE the greedy argmax and the top-k/top-p truncation, so a
+    constrained slot samples from the renormalized legal distribution
+    and a greedy constrained slot takes the best LEGAL token.  Like
+    every other knob it is per-slot data; an all-true mask is
+    bit-identical to ``None``.
     """
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, -jnp.inf)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     masked = filter_logits(logits, temperature, top_k, top_p)
     drawn = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
@@ -191,7 +201,7 @@ def sample(logits, key, temperature, top_k, top_p):
 
 
 def accept_resample(logits, draft, draft_len, key, temperature, top_k,
-                    top_p, forced=None):
+                    top_p, forced=None, allowed=None):
     """The speculative-decoding accept/resample kernel — ON DEVICE,
     per slot, provably lossless.
 
@@ -234,7 +244,18 @@ def accept_resample(logits, draft, draft_len, key, temperature, top_k,
     distribution whole-prompt prefill samples from (greedy rows: the raw
     argmax, the token-identity contract).  ``None`` (the default) is
     byte-identical to the pre-round-19 behavior.
+
+    ``allowed`` ([B, k+1, V] bool, optional): per-POSITION grammar
+    masks (round 22).  The scheduler builds them host-side by walking
+    the token DFA along the draft it is about to dispatch, so position
+    i's mask is conditioned on drafts 0..i-1 being accepted — masking
+    all k+1 positions is what lets constrained requests keep
+    speculating.  Applied before the argmaxes and the filter sweep,
+    exactly as in :func:`sample`; all-true is bit-identical to
+    ``None``.
     """
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, -jnp.inf)
     B, k1, V = logits.shape
     k = k1 - 1
     greedy_row = temperature <= 0.0                          # [B]

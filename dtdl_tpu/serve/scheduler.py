@@ -110,6 +110,7 @@ from dtdl_tpu.serve.metrics import ERROR_KINDS, ServeMetrics
 from dtdl_tpu.serve.paged import (GARBAGE_PAGE, PageAllocator,
                                   PagePoolExhaustedError)
 from dtdl_tpu.serve.sampling import GREEDY, SampleParams
+from dtdl_tpu.serve.tenant.lora import AdapterBankFullError
 
 _ids = itertools.count()
 
@@ -175,6 +176,17 @@ class Request:
                                                   repr=False)
     kv_handoff: Optional[dict] = dataclasses.field(default=None,
                                                    repr=False)
+    # multi-tenant fields (round 22, dtdl_tpu/serve/tenant/):
+    # ``adapter`` names a LoRA checkpoint path the engine's adapter
+    # bank hot-loads (None = base weights); ``grammar`` is a compiled
+    # tenant.grammar.TokenDFA constraining every emitted token (needs
+    # ``eos_id``: the DFA legalizes EOS only in accepting states);
+    # ``stream`` is a tenant.stream.TokenStream delivering tokens
+    # incrementally at each lag-harvest (prefix-stable under fleet
+    # retries/hedging — only the winning attempt streams).
+    adapter: Optional[str] = None
+    grammar: Any = dataclasses.field(default=None, repr=False)
+    stream: Any = dataclasses.field(default=None, repr=False)
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
@@ -187,9 +199,13 @@ class Request:
     t_done: float = 0.0
     admit_step: int = -1
     # internal: tokens guaranteed emitted by dispatched steps (>= 1 per
-    # step; exact for non-speculative slots) / slot retired
+    # step; exact for non-speculative slots) / slot retired / the
+    # grammar automaton's state over the HARVESTED tokens (lives on the
+    # request, not the slot: budget-retired slots keep harvesting
+    # windows after the row is reassigned)
     _guaranteed: int = dataclasses.field(default=0, repr=False)
     _retired: bool = dataclasses.field(default=False, repr=False)
+    _gq: int = dataclasses.field(default=0, repr=False)
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -408,6 +424,14 @@ class Scheduler:
         # paged+chunked: prefix-hash registration is deferred until the
         # prompt's pages are fully written (the final chunk's dispatch)
         self._slot_hashes: list = [None] * engine.n_slots
+        # multi-tenant LoRA (round 22): per-slot adapter-bank row ids,
+        # the [B] vector every decode/verify step consumes as DATA
+        # (row 0 = the all-zeros base adapter).  The scheduler owns the
+        # refcount lifecycle: acquire at admission, release at retire.
+        self._aids = np.zeros(engine.n_slots, np.int32)
+        if engine.adapter_bank is not None \
+                and engine.adapter_bank.observer is None:
+            engine.adapter_bank.observer = self.observer
 
     # ---- intake -------------------------------------------------------
 
@@ -440,6 +464,7 @@ class Scheduler:
         req.done = True
         req.t_done = time.perf_counter()
         self.finished.append(req)
+        self._stream_terminal(req)
         metric_hook(req)
         if req.origin_rid is None and req.admit_step >= 0:
             # a STANDALONE request that was admitted started a flow
@@ -457,6 +482,47 @@ class Scheduler:
         self._reqs[req.rid] = req
         return self._finish_error(req, reason, self.metrics.on_reject,
                                   "rejected")
+
+    def _stream_terminal(self, req: Request) -> None:
+        """Close out a streaming request's TokenStream at its terminal.
+
+        Ownership protocol (tenant/stream.py): a STANDALONE request
+        (``origin_rid`` is None) owns the user-facing stream outright,
+        so its terminal reconciles and closes it — success delivers any
+        suffix the lag harvest had not offered yet, an error closes
+        without delivering.  A fleet ATTEMPT only *releases* its claim,
+        and only on an error terminal, so a retry/hedge successor can
+        take over and the stream stays prefix-stable — the Router's
+        ``_finish_user`` owns the user-level close."""
+        if req.stream is None:
+            return
+        if req.origin_rid is None:
+            req.stream.finish(req.tokens, req.error)
+        elif req.error is not None:
+            req.stream.drop(req.rid)
+
+    def _acquire_adapter(self, req: Request) -> Optional[int]:
+        """Pin ``req``'s LoRA adapter in the engine's bank at admission
+        (hot-loading it through the manifest-checked checkpoint path
+        when cold).  Returns the bank row id (0 = base weights), or
+        None after error-finishing the request with a named reason: a
+        bank with every row pinned by live requests **sheds** with the
+        :class:`AdapterBankFullError` message (a capacity signal,
+        exactly the page-pool discipline), a corrupt or unreadable
+        adapter checkpoint **fails** — neither crashes the loop."""
+        if req.adapter is None:
+            return 0
+        try:
+            return self.engine.adapter_bank.acquire(req.adapter)
+        except AdapterBankFullError as e:
+            self.queue.remove(req)
+            self._finish_error(req, str(e), self.metrics.on_shed, "shed")
+        except Exception as e:
+            self.queue.remove(req)
+            self._finish_error(
+                req, f"adapter {req.adapter!r} failed to load: {e}",
+                self.metrics.on_failure, "failed")
+        return None
 
     def submit(self, req: Request) -> Request:
         """Enqueue ``req``; a request the scheduler cannot serve comes
@@ -484,6 +550,29 @@ class Scheduler:
             return self._reject(
                 req, f"admission queue full ({self.max_queue} waiting); "
                      f"retry later")
+        if req.adapter is not None and self.engine.adapter_bank is None:
+            return self._reject(
+                req, "adapter requests need an engine built with an "
+                     "adapter bank (lora_rank/lora_adapters)")
+        if req.grammar is not None:
+            # the DFA legalizes EOS only in accepting states, which is
+            # how a constrained request stops on a complete structure —
+            # without an eos_id the constraint could never terminate
+            if req.eos_id is None:
+                return self._reject(
+                    req, "grammar-constrained requests need eos_id (the "
+                         "automaton legalizes EOS in accepting states)")
+            if req.grammar.eos_id != req.eos_id:
+                return self._reject(
+                    req, f"grammar was compiled for eos_id="
+                         f"{req.grammar.eos_id} but the request has "
+                         f"eos_id={req.eos_id}")
+            if req.grammar.allow.shape[1] != self.engine.model.vocab_size:
+                return self._reject(
+                    req, f"grammar was compiled over a vocab of "
+                         f"{req.grammar.allow.shape[1]} tokens; the "
+                         f"engine serves "
+                         f"{self.engine.model.vocab_size}")
         if req.prefill_only and req.kv_inject is not None:
             raise ValueError("prefill_only and kv_inject are mutually "
                              "exclusive (one request is one half of a "
@@ -573,6 +662,13 @@ class Scheduler:
         self._temp[slot] = 0.0
         self._topk[slot] = 0
         self._topp[slot] = 1.0
+        # drop this slot's claim on its LoRA bank row: refcount 0 makes
+        # the row LRU-evictable for the next cold adapter, while the
+        # weights stay resident for a warm re-acquire (row 0, the base
+        # adapter, is never refcounted — release(0) is a no-op)
+        if self._aids[slot]:
+            self.engine.adapter_bank.release(int(self._aids[slot]))
+            self._aids[slot] = 0
         if self.pages is not None:
             # release the slot's pages (cached prefix pages become
             # evictable, private pages free immediately) and point the
@@ -758,6 +854,9 @@ class Scheduler:
                 if self._admit_inject(slot, req):
                     continue
                 break                  # pool backpressure: FIFO waits
+            aid = self._acquire_adapter(req)
+            if aid is None:
+                continue               # shed/failed with a named error
             chunked = self.chunk_tokens is not None
             suffix, start, row = req.prompt, 0, None
             hits, fresh, hashes = [], [], []
@@ -802,7 +901,9 @@ class Scheduler:
                 evictable_hits = sum(
                     1 for p in hits if self.pages.refcount(p) == 0)
                 if need + evictable_hits > self.pages.available:
-                    break
+                    if aid:   # un-pin the adapter row while FIFO waits:
+                        self.engine.adapter_bank.release(aid)
+                    break     # re-acquired (warm) when pages free up
                 for p in hits:          # pin BEFORE alloc can evict them
                     self.pages.acquire(p)
                 fresh = [self.pages.alloc() for _ in range(need)]
@@ -823,6 +924,12 @@ class Scheduler:
                 # behind it (the interference the chunked path removes;
                 # the counter is the before/after bench receipt)
                 self.metrics.on_prefill_block(int(self._active.sum()))
+                # grammar: the prefill's bonus sample IS the request's
+                # first OUTPUT token, so it draws under the automaton's
+                # start-state mask (the prompt itself never advances
+                # the DFA — grammars constrain output only)
+                g0 = (req.grammar.mask(req.grammar.start)[None, :]
+                      if req.grammar is not None else None)
                 try:
                     with self.observer.span("prefill", slot=slot,
                                             suffix_len=len(suffix),
@@ -831,7 +938,10 @@ class Scheduler:
                             self.engine.prefill(
                                 self.arena, self.last_tokens, slot,
                                 suffix, sp, self._next_key(),
-                                page_row=row, start=start)
+                                page_row=row, start=start,
+                                adapter_id=(aid if self.engine.adapter_bank
+                                            is not None else None),
+                                allowed=g0)
                 except Exception as e:
                     # the arena was donated into the failing program:
                     # condemn the in-flight batch (and this request),
@@ -840,6 +950,8 @@ class Scheduler:
                     self._finish_error(
                         req, f"engine failure: {self.last_engine_error}",
                         self.metrics.on_failure, "failed")
+                    if aid:   # not slotted yet — _contain missed it
+                        self.engine.adapter_bank.release(aid)
                     return
             if self.pages is not None:
                 self._ptab[slot] = row
@@ -859,6 +971,9 @@ class Scheduler:
                 self.metrics.on_prefix(len(hits), len(hashes), start)
             self.slots[slot] = req
             self._active[slot] = True
+            self._aids[slot] = aid
+            if req.grammar is not None:
+                req._gq = req.grammar.start
             self._state[slot] = _SlotState(
                 req.rid, start if chunked else len(req.prompt),
                 req.speculate,
@@ -916,6 +1031,22 @@ class Scheduler:
         n_pg = int(payload["n_pages"])
         if n_pg > self.pages.available:
             return False
+        aid = self._acquire_adapter(req)
+        if aid is None:
+            return True            # error-finished with a named reason
+        if req.grammar is not None:
+            # catch the automaton up over the tokens the prefill half
+            # already delivered (the seeded first token): the migrated
+            # stream must continue under the same constraint
+            req._gq = req.grammar.walk(req.tokens)
+            if req._gq < 0:
+                self.queue.remove(req)
+                self._finish_error(
+                    req, "migrated tokens violate the request's grammar",
+                    self.metrics.on_failure, "failed")
+                if aid:
+                    self.engine.adapter_bank.release(aid)
+                return True
         self.queue.popleft()
         corr = self._corr(req)
         fresh = [self.pages.alloc() for _ in range(n_pg)]
@@ -934,6 +1065,8 @@ class Scheduler:
             self._finish_error(
                 req, f"engine failure: {self.last_engine_error}",
                 self.metrics.on_failure, "failed")
+            if aid:       # not slotted yet — _contain missed it
+                self.engine.adapter_bank.release(aid)
             return True
         self._ptab[slot] = row
         self._slot_pages[slot] = list(fresh)
@@ -949,6 +1082,7 @@ class Scheduler:
         sp = req.sampling
         self.slots[slot] = req
         self._active[slot] = True
+        self._aids[slot] = aid
         self._state[slot] = _SlotState(req.rid, len(req.prompt),
                                        req.speculate)
         self._temp[slot] = sp.temperature
@@ -1146,7 +1280,31 @@ class Scheduler:
             if st is not None and step_act[slot] and st.prefilling \
                     and slot not in chunk_plan:
                 step_act[slot] = False
+        # grammar gate: a constrained slot dispatches only when nothing
+        # of its own is in flight — the token mask is a function of the
+        # automaton state, which is exact only over HARVESTED truth.
+        # Prefill chunks are exempt (prompt truth carries no automaton
+        # state).  Speculation recovers the throughput the gate costs:
+        # the one outstanding verify step still commits up to k+1
+        # tokens, all masked by walking the DFA along the draft.
+        gated = False
+        for slot in range(B):
+            req, st = self.slots[slot], self._state[slot]
+            if req is None or req.grammar is None or not step_act[slot] \
+                    or st.prefilling:
+                continue
+            if st.inflight:
+                step_act[slot] = False
+                desires.pop(slot, None)
+                gated = True
         if not step_act.any():
+            if gated and self._pending:
+                # settle the oldest window so the gated automata advance
+                # and the next round can dispatch them — without this a
+                # lone constrained slot would never reach the lag
+                # threshold and the loop would spin forever
+                with self.observer.span("harvest", grammar=1):
+                    self._harvest_one()
             return
         # the room bound covers EVERY active slot, stepped or not: the
         # dense verify scatter writes its k_prog+1 window into every
@@ -1214,6 +1372,24 @@ class Scheduler:
                     pred = np.asarray(
                         self.draft.propose(ctx, gap + want), np.int32)
                     cand = pred[gap:gap + want]   # skip in-flight gap
+                    if req.grammar is not None:
+                        # trim at the first illegal draft token: the
+                        # verify mask would reject everything from it
+                        # on anyway (wasted k), and a shorter draft
+                        # keeps the acceptance EMA honest.  gap is 0
+                        # here (the grammar gate dispatches only with
+                        # an empty inflight queue) so ``req._gq`` is
+                        # exactly the state the draft continues from.
+                        q, keep = req._gq, 0
+                        for t in cand:
+                            q = req.grammar.step(q, int(t))
+                            if q < 0:
+                                break
+                            keep += 1
+                        if keep < cand.size:
+                            self.metrics.on_grammar_reject(
+                                int(cand.size) - keep)
+                            cand = cand[:keep]
                     dl = int(cand.size)
                     drafts[slot, :dl] = cand
                     lens[slot] = dl
@@ -1254,6 +1430,8 @@ class Scheduler:
                 else:
                     entries.append((slot, req.rid, int(lens[slot]), 0))
             entries = tuple(entries)
+            g_allowed = self._grammar_masks(step_act, chunk_plan,
+                                            drafts, lens, k_prog)
             with self.observer.span("verify", n_active=n_active,
                                     k=k_prog):
                 (self.arena, self.last_tokens, window,
@@ -1262,7 +1440,9 @@ class Scheduler:
                     step_act, self._next_key(), self._temp,
                     self._topk, self._topp, page_tables=tables,
                     forced=forced, first_tok=first_tok,
-                    pos_set=pos_set)
+                    pos_set=pos_set, allowed=g_allowed,
+                    adapter_ids=(self._aids if self.engine.adapter_bank
+                                 is not None else None))
             self._pending.append((window, counts, entries))
             if n_drafted:
                 self.metrics.on_verify(k_prog)
@@ -1291,11 +1471,23 @@ class Scheduler:
                 (slot, req.rid, 0, 0)
                 for slot, req in enumerate(self.slots)
                 if step_act[slot])
+            g_allowed = None
+            g_rows = [s for s in range(B) if step_act[s]
+                      and self.slots[s] is not None
+                      and self.slots[s].grammar is not None]
+            if g_rows:
+                g_allowed = np.ones(
+                    (B, self.engine.model.vocab_size), bool)
+                for s in g_rows:
+                    r = self.slots[s]
+                    g_allowed[s] = r.grammar.mask(r._gq)
             with self.observer.span("dispatch", n_active=n_active):
                 self.arena, self.last_tokens, _ = self.engine.decode(
                     self.arena, self.last_tokens, step_act,
                     self._next_key(), self._temp, self._topk,
-                    self._topp, page_tables=tables)
+                    self._topp, page_tables=tables, allowed=g_allowed,
+                    adapter_ids=(self._aids if self.engine.adapter_bank
+                                 is not None else None))
             self._pending.append((self.last_tokens, None, entries))
             for slot, rid, _, _ in entries:
                 self._state[slot].dispatched(0)
@@ -1310,6 +1502,45 @@ class Scheduler:
                 # prefill-role replica: park until the first token
                 # harvests and the page payload is extracted
                 self._active[slot] = False
+
+    def _grammar_masks(self, step_act, chunk_plan, drafts, lens,
+                       k_prog):
+        """Per-position allowed-token masks for one verify step, or
+        None when no stepped slot is grammar-constrained (the engine
+        then reuses its cached all-true mask — nothing uploads).
+
+        Rows are host numpy slices of each DFA's precomputed ``allow``
+        table — building the [B, k+1, V] block is pure host indexing at
+        the dispatch boundary, uploaded as data like the page tables.
+        For a decode/spec row, position 0 masks from the harvested
+        state and each later position from the state after the
+        corresponding (pre-trimmed, hence legal) draft token; for a
+        chunk row only the FINAL chunk's bonus position is constrained
+        (the request's first output token — start-state mask), prompt
+        echo positions are forced-accept and stay all-true."""
+        B = self.engine.n_slots
+        rows = [s for s in range(B) if step_act[s]
+                and self.slots[s] is not None
+                and self.slots[s].grammar is not None]
+        if not rows:
+            return None
+        allowed = np.ones((B, k_prog + 1,
+                           self.engine.model.vocab_size), bool)
+        for slot in rows:
+            req = self.slots[slot]
+            dfa = req.grammar
+            if slot in chunk_plan:
+                st = self._state[slot]
+                w = chunk_plan[slot]
+                if st.fill_next + w == st.fill_end:
+                    allowed[slot, w - 1] = dfa.mask(dfa.start)
+                continue
+            q = req._gq
+            allowed[slot, 0] = dfa.mask(q)
+            for i in range(int(lens[slot])):
+                q = dfa.step(q, int(drafts[slot, i]))
+                allowed[slot, i + 1] = dfa.mask(q)
+        return allowed
 
     # ---- harvest ------------------------------------------------------
 
@@ -1348,6 +1579,27 @@ class Scheduler:
             for t in toks:
                 req.tokens.append(int(t))
                 delivered += 1
+                if req.grammar is not None:
+                    # advance the automaton over the delivered token —
+                    # this is the state every later dispatch masks
+                    # from.  A rejection here is defense in depth (the
+                    # dispatch masks make it unreachable for sampled
+                    # tokens): contain it as a failed request, never
+                    # deliver the illegal token.
+                    q = req.grammar.step(req._gq, int(t))
+                    if q < 0:
+                        req.tokens.pop()
+                        delivered -= 1
+                        self.observer.event(
+                            "grammar_violation", token=int(t),
+                            reason="illegal", **self._corr(req))
+                        self._finish_error(
+                            req, f"grammar violation: token {int(t)} "
+                                 f"is illegal in automaton state "
+                                 f"{req._gq}",
+                            self.metrics.on_failure, "failed")
+                        break
+                    req._gq = q
                 if len(req.tokens) == 1:
                     req.t_first = now
                     self.metrics.on_first_token(req)
@@ -1364,6 +1616,15 @@ class Scheduler:
                     self.observer.event("request_finished",
                                         tokens=len(req.tokens),
                                         eos=int(hit_eos), **corr)
+                    if req.grammar is not None \
+                            and not req.grammar.accept[req._gq]:
+                        # token budget ran out mid-structure: the
+                        # output is legal-so-far but not a complete
+                        # utterance of the grammar — observable, not
+                        # an error (EOS can only land in accepting
+                        # states, so this is always a truncation)
+                        self.observer.event("grammar_violation",
+                                            reason="incomplete", **corr)
                     self.observer.flow(
                         "req", corr["rid"],
                         "step" if req.origin_rid is not None else "end")
@@ -1372,11 +1633,28 @@ class Scheduler:
             # (the request's very first token is the prefill's)
             self.metrics.on_harvest_tokens(
                 delivered - (1 if first_window and delivered else 0))
+            if delivered:
+                self.metrics.on_adapter_tokens(req.adapter or "base",
+                                               delivered)
+                if req.stream is not None:
+                    # incremental delivery from the lag-harvested
+                    # window: first offerer owns the stream (hedge
+                    # losers get 0), extensions are prefix-guarded
+                    n = req.stream.offer(req.rid, req.tokens)
+                    if n:
+                        self.metrics.on_stream(n)
+                        self.observer.event("stream_delivery", tokens=n,
+                                            **self._corr(req))
             if req.prefill_only and not req.done and req.tokens:
                 # prefill-role completion: first token known, more
                 # generation owed — export the page payload for the
                 # decode half of the flight (round 19)
                 self._handoff_out(slot, req)
+            if req.done and req.error is None:
+                # success terminal: a standalone request closes its
+                # stream here (reconciling any unoffered suffix); a
+                # fleet attempt leaves it to the Router's _finish_user
+                self._stream_terminal(req)
             if req.done and self.slots[slot] is req:
                 self._retire(slot)
 

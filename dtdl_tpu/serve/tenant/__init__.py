@@ -1,0 +1,22 @@
+"""Multi-tenant serving: batched multi-LoRA adapters, grammar-
+constrained decoding, and per-request token streaming — three legs
+sharing the slot machinery so heterogeneous per-tenant traffic rides
+the same three compiled program families as plain decode."""
+
+from dtdl_tpu.serve.tenant.grammar import (TokenDFA, byte_vocab,
+                                           compile_json_schema,
+                                           compile_regex,
+                                           json_schema_to_regex)
+from dtdl_tpu.serve.tenant.lora import (AdapterBank, AdapterBankFullError,
+                                        adapter_template, bank_nbytes,
+                                        bank_pspecs, init_bank,
+                                        merge_adapter)
+from dtdl_tpu.serve.tenant.stream import TokenStream
+
+__all__ = [
+    "TokenDFA", "byte_vocab", "compile_json_schema", "compile_regex",
+    "json_schema_to_regex",
+    "AdapterBank", "AdapterBankFullError", "adapter_template",
+    "bank_nbytes", "bank_pspecs", "init_bank", "merge_adapter",
+    "TokenStream",
+]

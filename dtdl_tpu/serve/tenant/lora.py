@@ -1,0 +1,241 @@
+"""Batched multi-LoRA: a device-resident adapter bank + host registry.
+
+One compiled decode/verify/prefill step serves many fine-tunes by
+making the adapter identity *data*: every LoRA factor lives stacked in
+a ``[n_adapters, ...]`` bank in HBM, and each slot carries an int32
+adapter id that the attention layer uses to gather its rows inside the
+compiled step (``jnp.take`` along axis 0 — no program axis, no
+recompile).  Row 0 is reserved for the all-zeros *base* adapter, so
+un-adapted requests run the same math with a zero delta.
+
+Host side, :class:`AdapterBank` is a refcounted name -> row registry
+with LRU eviction.  Adapters hot-load from disk through the manifest
+integrity path (:func:`dtdl_tpu.ckpt.checkpoint.load_weights`), so a
+truncated or bit-flipped adapter raises ``CheckpointCorruptError``
+instead of silently serving garbage.  When every row is pinned by a
+live request, ``acquire`` raises :class:`AdapterBankFullError` — the
+scheduler sheds that request rather than blocking the batch.
+
+Sharding (PR 14/15 TP rules): the rank axis is tiny and stays
+replicated; the axis each factor shares with its base kernel follows
+that kernel's logical spec — B factors and ``out_a`` shard over heads
+(MODEL_AXIS), A factors and ``out_b`` are replicated.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtdl_tpu.ckpt.checkpoint import load_weights
+
+__all__ = [
+    "AdapterBankFullError",
+    "AdapterBank",
+    "LORA_LEAVES",
+    "adapter_template",
+    "init_bank",
+    "merge_adapter",
+    "bank_pspecs",
+    "bank_nbytes",
+]
+
+# Per-block leaf names and their shapes as functions of
+# (d_model, n_heads, head_dim, rank).  A/B factor pairs for the q/k/v
+# projections plus the output projection; the delta is B(A(x)) with the
+# rank axis contracted between them.
+LORA_LEAVES = ("q_a", "q_b", "k_a", "k_b", "v_a", "v_b", "out_a", "out_b")
+
+
+def _leaf_shape(name: str, d: int, h: int, dh: int, r: int) -> Tuple[int, ...]:
+    if name.endswith("_a") and name != "out_a":
+        return (d, r)
+    if name == "out_a":
+        return (h, dh, r)
+    if name == "out_b":
+        return (r, d)
+    return (r, h, dh)          # q_b / k_b / v_b
+
+
+class AdapterBankFullError(RuntimeError):
+    """Every adapter row is pinned by a live request."""
+
+    def __init__(self, name: str, capacity: int) -> None:
+        super().__init__(
+            f"adapter bank full: cannot load {name!r}, all "
+            f"{capacity - 1} rows are referenced by live requests")
+        self.name = name
+        self.capacity = capacity
+
+
+def _dims(params) -> Tuple[int, int, int, List[str]]:
+    """Infer (d_model, n_heads, head_dim, block names) from params."""
+    blocks = sorted((k for k in params if k.startswith("block_")),
+                    key=lambda k: int(k.split("_")[1]))
+    qk = params[blocks[0]]["attn"]["q"]["kernel"]
+    d, h, dh = int(qk.shape[0]), int(qk.shape[1]), int(qk.shape[2])
+    return d, h, dh, blocks
+
+
+def adapter_template(params, rank: int, dtype=jnp.float32):
+    """Host-side zeros tree in the on-disk single-adapter layout:
+    ``{"block_i": {"attn": {leaf: array}}}`` — what ``save_weights``
+    stores and what ``acquire`` validates uploads against."""
+    d, h, dh, blocks = _dims(params)
+    return {b: {"attn": {n: np.zeros(_leaf_shape(n, d, h, dh, rank),
+                                     dtype=dtype)
+                         for n in LORA_LEAVES}}
+            for b in blocks}
+
+
+def init_bank(params, rank: int, n_adapters: int, dtype=jnp.float32):
+    """Device zeros bank: every leaf gains a leading ``[n_adapters]``
+    axis; row 0 is the base (all-zeros) adapter and is never evicted."""
+    d, h, dh, blocks = _dims(params)
+    return {b: {"attn": {n: jnp.zeros((n_adapters,)
+                                      + _leaf_shape(n, d, h, dh, rank),
+                                      dtype=dtype)
+                         for n in LORA_LEAVES}}
+            for b in blocks}
+
+
+def merge_adapter(params, adapter):
+    """The math oracle: fold one adapter into dense kernels, so batched
+    gathered execution can be pinned against a merged-weights model."""
+    out = jax.tree_util.tree_map(lambda x: x, params)  # shallow-ish copy
+    merged = {k: v for k, v in out.items()}
+    for b, sub in adapter.items():
+        leaves = sub["attn"]
+        attn = dict(merged[b]["attn"])
+        for proj in ("q", "k", "v"):
+            a, bb = leaves[f"{proj}_a"], leaves[f"{proj}_b"]
+            delta = jnp.einsum("dr,rhe->dhe", a, bb)
+            node = dict(attn[proj])
+            node["kernel"] = attn[proj]["kernel"] + delta.astype(
+                attn[proj]["kernel"].dtype)
+            attn[proj] = node
+        a, bb = leaves["out_a"], leaves["out_b"]
+        delta = jnp.einsum("her,rd->hed", a, bb)
+        node = dict(attn["out"])
+        node["kernel"] = attn["out"]["kernel"] + delta.astype(
+            attn["out"]["kernel"].dtype)
+        attn["out"] = node
+        blk = dict(merged[b])
+        blk["attn"] = attn
+        merged[b] = blk
+    return merged
+
+
+def bank_pspecs(bank):
+    """PartitionSpec tree for the bank under the TP rules: the heads
+    axis shards over MODEL_AXIS wherever a factor has one; the rank
+    axis (and the adapter axis) stay replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from dtdl_tpu.runtime.mesh import MODEL_AXIS
+
+    def spec(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("q_b", "k_b", "v_b"):      # [n, r, H, Dh]
+            return P(None, None, MODEL_AXIS, None)
+        if name == "out_a":                    # [n, H, Dh, r]
+            return P(None, MODEL_AXIS, None, None)
+        return P(*([None] * leaf.ndim))
+    return jax.tree_util.tree_map_with_path(spec, bank)
+
+
+def bank_nbytes(bank) -> int:
+    return int(sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(bank)))
+
+
+class AdapterBank:
+    """Refcounted host registry over the device-resident bank.
+
+    ``acquire(path)`` returns the int row id for the adapter at
+    ``path`` (``None`` -> 0, the base row), loading it through the
+    manifest-integrity checkpoint path on first use and evicting the
+    least-recently-used unreferenced row when full.  ``release(aid)``
+    decrements; rows are only reclaimable at refcount 0.
+    """
+
+    def __init__(self, bank, template, observer=None) -> None:
+        self.bank = bank
+        self.template = template
+        leaf = jax.tree_util.tree_leaves(bank)[0]
+        self.capacity = int(leaf.shape[0])
+        self.observer = observer
+        self._by_name: Dict[str, int] = {}
+        self._name_of: Dict[int, str] = {}
+        self._refs: Dict[int, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._free: List[int] = list(range(1, self.capacity))
+        self.n_loads = 0
+        self.n_evictions = 0
+
+    # -- registry -------------------------------------------------------
+    def acquire(self, path: Optional[str]) -> int:
+        if path is None:
+            return 0
+        aid = self._by_name.get(path)
+        if aid is not None:
+            self._refs[aid] += 1
+            self._lru.pop(aid, None)
+            self._lru[aid] = None
+            return aid
+        aid = self._grab_row(path)
+        adapter = load_weights(path, like=self.template)
+        self._upload(aid, adapter)
+        self._by_name[path] = aid
+        self._name_of[aid] = path
+        self._refs[aid] = 1
+        self._lru[aid] = None
+        self.n_loads += 1
+        if self.observer is not None:
+            self.observer.event("adapter_loaded", adapter=path, row=aid)
+        return aid
+
+    def release(self, aid: int) -> None:
+        if aid == 0:
+            return
+        self._refs[aid] -= 1
+
+    def _grab_row(self, name: str) -> int:
+        if self._free:
+            return self._free.pop()
+        for aid in self._lru:               # oldest first
+            if self._refs.get(aid, 0) == 0:
+                return self._evict(aid)
+        raise AdapterBankFullError(name, self.capacity)
+
+    def _evict(self, aid: int) -> int:
+        old = self._name_of.pop(aid)
+        del self._by_name[old]
+        del self._refs[aid]
+        del self._lru[aid]
+        self.n_evictions += 1
+        if self.observer is not None:
+            self.observer.event("adapter_evicted", adapter=old, row=aid)
+        # No device-side zeroing: the row is fully overwritten by the
+        # incoming adapter before any slot can reference it, and the
+        # stream ordering of already-dispatched steps protects in-flight
+        # readers of the old row (same discipline as arena donation).
+        return aid
+
+    def _upload(self, aid: int, adapter) -> None:
+        def put(dst, src):
+            return dst.at[aid].set(jnp.asarray(src, dtype=dst.dtype))
+        self.bank = jax.tree_util.tree_map(put, self.bank,
+                                           adapter)
+
+    # -- introspection --------------------------------------------------
+    def resident(self) -> Dict[str, int]:
+        return dict(self._by_name)
+
+    def refcount(self, path: str) -> int:
+        aid = self._by_name.get(path)
+        return 0 if aid is None else self._refs[aid]

@@ -76,6 +76,20 @@ def main():
          help="int8 serving (dtdl_tpu/quant): w8 = weight-only int8 "
               "matmuls, w8kv8 = + int8 KV arena; same compiled "
               "programs, ~4x less parameter HBM traffic")
+    flag(parser, "--lora", default="",
+         help="multi-tenant LoRA: comma-separated adapter checkpoint "
+              "paths; requests round-robin over base + adapters, all "
+              "batched through the SAME compiled steps (a missing path "
+              "gets a random demo adapter saved there)")
+    flag(parser, "--lora-rank", type=int, default=8,
+         help="adapter rank for --lora (must match saved adapters)")
+    flag(parser, "--json-schema", default="",
+         help="grammar-constrained decoding: a JSON-schema file; every "
+              "request's output is masked to valid JSON for it "
+              "(vocab must cover ASCII, i.e. >= 128)")
+    flag(parser, "--stream", action="store_true",
+         help="attach a TokenStream per request and echo the first "
+              "requests' tokens as the lag-harvest windows deliver them")
     flag(parser, "--seed", type=int, default=0)
     flag(parser, "--trace", default="",
          help="write a Chrome-trace-event JSON (Perfetto-loadable) of "
@@ -95,6 +109,21 @@ def main():
         from dtdl_tpu.ckpt import load_weights
         params = load_weights(args.restore, params)
 
+    lora_paths = [p for p in args.lora.split(",") if p]
+    for p in lora_paths:
+        # out-of-the-box demo: synthesize (and persist) an adapter for
+        # any path that doesn't exist yet
+        import os
+        if not os.path.exists(p):
+            from dtdl_tpu.ckpt import save_weights
+            from dtdl_tpu.serve import adapter_template
+            tpl = adapter_template(params, rank=args.lora_rank)
+            arng = np.random.default_rng(hash(p) % (2 ** 31))
+            save_weights(p, jax.tree_util.tree_map(
+                lambda x: np.asarray(arng.normal(0, 0.02, x.shape),
+                                     np.float32), tpl))
+            print(f"  --lora: saved demo adapter to {p}")
+
     from dtdl_tpu.obs import Observer
     obs = Observer(trace_path=args.trace or None, sentinel="warn")
     engine = InferenceEngine(model, params, n_slots=args.n_slots,
@@ -102,7 +131,11 @@ def main():
                              n_pages=args.n_pages or None,
                              quantize_weights=args.quantize != "none",
                              kv_dtype=("int8" if args.quantize == "w8kv8"
-                                       else None))
+                                       else None),
+                             lora_rank=(args.lora_rank if lora_paths
+                                        else 0),
+                             lora_adapters=(len(lora_paths) + 1
+                                            if lora_paths else 0))
     draft = None
     if args.speculate and args.draft == "model":
         # demo draft transformer: a narrower random-init LM sharing the
@@ -133,10 +166,43 @@ def main():
     common = rng.integers(0, model.vocab_size,
                           args.shared_prefix).tolist()
     lens = rng.integers(4, hi, args.n_requests)
+
+    dfa = None
+    eos = None
+    if args.json_schema:
+        import json as _json
+        if model.vocab_size < 128:
+            parser.error("--json-schema needs a vocab covering ASCII "
+                         f"(>= 128); this model has {model.vocab_size}")
+        from dtdl_tpu.serve import byte_vocab, compile_json_schema
+        with open(args.json_schema) as f:
+            schema = _json.load(f)
+        eos = model.vocab_size - 1
+        dfa = compile_json_schema(schema, byte_vocab(model.vocab_size),
+                                  eos_id=eos)
+        print(f"  --json-schema: {dfa.n_states}-state token DFA "
+              f"({dfa.nbytes():,} bytes of masks)")
+
+    def mk_stream(i):
+        if not args.stream:
+            return None
+        from dtdl_tpu.serve import TokenStream
+        if i >= 2:              # echo only the first requests
+            return TokenStream()
+        return TokenStream(callback=lambda new, i=i: print(
+            f"    stream req {i}: +{new}"))
+
+    # round-robin tenants: base, then each --lora adapter in turn
+    tenants = [None] + lora_paths
     reqs = [Request(common + rng.integers(0, model.vocab_size,
                                           n).tolist(),
                     args.max_new_tokens, sampling=sp,
-                    speculate=args.speculate) for n in lens]
+                    speculate=args.speculate,
+                    adapter=tenants[i % len(tenants)],
+                    grammar=dfa, eos_id=(eos if dfa is not None
+                                         else None),
+                    stream=mk_stream(i))
+            for i, n in enumerate(lens)]
 
     t0 = time.perf_counter()
     sched.run(reqs)
@@ -200,6 +266,29 @@ def main():
               f"tokens/step {s['tokens_per_step_mean']:.2f}  "
               f"accepted-tok/s p50/p95: {pct(0.5):.1f} / {pct(0.95):.1f}  "
               f"draft overhead {s['draft_s'] * 1e3:.1f}ms")
+    if lora_paths:
+        # the multi-tenant receipts: per-adapter delivered tokens, all
+        # through ONE decode program (adapter ids are data)
+        by = s["tokens_by_adapter"]
+        mix = "  ".join(f"{k.rsplit('/', 1)[-1]}={v}"
+                        for k, v in sorted(by.items()))
+        print(f"  multi-lora ({len(lora_paths)} adapters, rank "
+              f"{args.lora_rank}): tokens by tenant: {mix}  bank loads "
+              f"{engine.adapter_bank.n_loads} evictions "
+              f"{engine.adapter_bank.n_evictions}")
+    if dfa is not None:
+        ok = sum(1 for r in reqs if r.error is None)
+        print(f"  constrained ({args.json_schema}): {ok}/{len(reqs)} "
+              f"requests completed valid JSON; illegal draft tokens "
+              f"trimmed {s['grammar_rejected_tokens']}")
+        for r in reqs[:2]:
+            body = r.tokens[:-1] if r.tokens and r.tokens[-1] == eos \
+                else r.tokens
+            print(f"    req {r.rid}: "
+                  f"{''.join(chr(t) for t in body)!r}")
+    if args.stream:
+        print(f"  streaming: {s['stream_deliveries']} incremental "
+              f"deliveries across {len(reqs)} requests")
     print("compiled programs:", engine.compile_stats())
     if args.trace:
         print(f"trace written to {obs.save()}", flush=True)

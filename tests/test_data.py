@@ -222,6 +222,7 @@ def test_cifar10_load_downloads_when_missing(tmp_path, monkeypatch):
     assert tr_i.shape == (100, 32, 32, 3)
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_cifar10_synthetic_fallback_is_loud(tmp_path, caplog):
     import logging
     with caplog.at_level(logging.WARNING, logger="dtdl_tpu"):

@@ -169,6 +169,7 @@ def test_train_lm_4d_example(tmp_path):
     assert g and len(g.group(1).split(",")) == 12, out  # 8 prompt + 4 new
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_train_lm_gspmd_example(tmp_path):
     """GSPMD expert-parallel LM training end-to-end: 'ep' rules on a
     (2,2) mesh (the CPU env fakes 4 devices), routed capacity dispatch —

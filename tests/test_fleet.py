@@ -697,3 +697,94 @@ def test_role_fleet_requires_paged_decode_capable(engine, paged_engine):
     # an all-mixed fleet (no migrations possible) stays dense-legal
     r = Router([engine, engine], roles=["mixed", "mixed"], warmup=False)
     r.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant round 22: role-fleet hedging + fleet token streaming
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_role_fleet_hedges_mixed_primary(engine, oracle):
+    """PR 14 known-remaining, fixed: a role fleet may hedge when the
+    primary attempt runs WHOLE on a mixed replica — here an all-mixed
+    fleet with hedge_after_s=0 (which used to be a constructor
+    ValueError) hedges every request, first completion wins, and every
+    request is token-exact."""
+    prompts, want = oracle
+    with Router(engine, roles=["mixed", "mixed"], hedge_after_s=0.0,
+                **kw()) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        s = router.summary()
+    for r, toks in zip(reqs, want):
+        assert r.error is None and r.tokens == toks
+    assert s["fleet_hedges"] >= 1
+    assert s["fleet_requests_finished"] == len(prompts)
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.fleet
+def test_staged_fleet_never_hedges_migrated_flights(paged_engine,
+                                                    paged_oracle):
+    """The other half of the pin: a prefill/decode fleet with hedging
+    enabled constructs and completes token-identical, but a flight
+    whose KV migrates is never hedged — two handoff payloads must not
+    race one migration — so the hedge counter stays at zero."""
+    prompts, want = paged_oracle
+    with Router(paged_engine, roles=["prefill", "decode"],
+                hedge_after_s=0.0,
+                **kw(sched_kwargs={"harvest_lag": 1,
+                                   "chunk_tokens": 4})) as router:
+        reqs = router.run([Request(list(p), N_NEW) for p in prompts])
+        s = router.summary()
+    for r, toks in zip(reqs, want):
+        assert r.done and r.error is None, r
+        assert r.tokens == toks
+    assert s["fleet_hedges"] == 0
+    assert s["fleet_migrations"] == len(prompts)
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.fleet
+def test_fleet_streams_reconcile_to_final_tokens(engine, oracle):
+    """Streaming through the Router: each user stream closes equal to
+    its request's final tokens, non-divergent, with deliveries counted
+    fleet-wide."""
+    from dtdl_tpu.serve import TokenStream
+    prompts, want = oracle
+    streams = [TokenStream() for _ in prompts]
+    with Router(engine, n_replicas=2, **kw()) as router:
+        reqs = router.run([Request(list(p), N_NEW, stream=s)
+                           for p, s in zip(prompts, streams)])
+        s = router.summary()
+    for r, toks, st in zip(reqs, want, streams):
+        assert r.error is None and r.tokens == toks
+        assert st.closed and not st.divergent
+        assert st.tokens == r.tokens
+    assert s["fleet_stream_deliveries"] >= len(prompts)
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_streams_prefix_stable_under_retry(engine, oracle):
+    """The retry/hedge stream pin: with a replica dying mid-flight and
+    attempts retried, only the WINNING attempt streams — every stream
+    closes non-divergent, token-identical to its request (a failed
+    request's stream closes carrying the named error)."""
+    prompts, want = oracle
+    plan = FaultPlan().at(replica_site(0, "loop"), 2)
+    from dtdl_tpu.serve import TokenStream
+    streams = [TokenStream() for _ in prompts]
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=False,
+                **kw()) as router:
+        reqs = router.run([Request(list(p), N_NEW, stream=s)
+                           for p, s in zip(prompts, streams)])
+        s = router.summary()
+    for r, toks, st in zip(reqs, want, streams):
+        assert st.closed, "stream left open after terminal"
+        if r.error is None:
+            assert r.tokens == toks
+            assert not st.divergent and st.tokens == r.tokens
+        else:
+            assert st.error == r.error
+    assert s["fleet_accounting_ok"]

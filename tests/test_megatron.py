@@ -577,6 +577,7 @@ def test_moe_top2_routed_matches_dense(devices):
                                    **PARAM_TOL)
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_moe_aux_loss_flattens_expert_utilization(devices):
     """The Switch load-balance loss is IN the training loss, not just a
     metric: training a routed top-1 MoE at tight capacity (cf=1.0) must

@@ -1,0 +1,561 @@
+"""Multi-tenant serving: batched LoRA, grammar decoding, token streams.
+
+The three ISSUE-17 acceptance pins, on the tiny f32 dense config (one
+shared LoRA-capable engine for the whole module, wrapped in a
+``RecompileSentinel(policy='raise')`` so every test doubles as a
+zero-recompile receipt):
+
+* **LoRA identity** — a mixed batch where slots carry different adapter
+  ids produces, per request, exactly the tokens of a solo greedy decode
+  against that adapter's weights *merged* into the dense kernels
+  (``merge_adapter`` is the math oracle); base requests on the LoRA
+  engine match the unadapted model bit-for-bit (row 0 is all-zeros).
+* **constrained identity** — a grammar-constrained run equals an eager
+  one-at-a-time oracle that masks logits with the same DFA before every
+  argmax, including under speculation (all k+1 verify positions masked)
+  and chunked prefill (final-chunk bonus position masked).
+* **stream identity** — every streamed sequence is prefix-stable and
+  reconciles to exactly ``Request.tokens``; fleet/retry variants live
+  in tests/test_fleet.py.
+
+Unit coverage rides along: regex/JSON-schema -> token DFA compilation,
+the TokenStream ownership protocol, AdapterBank refcount/LRU/full/
+corrupt-checkpoint behavior, submit-time validation rejections, and the
+dict-valued window-counter flattening in ServeMetrics.
+"""
+
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from dtdl_tpu.ckpt.checkpoint import save_weights
+from dtdl_tpu.models.transformer import transformer_lm
+from dtdl_tpu.obs import Observer
+from dtdl_tpu.serve import (
+    AdapterBank, AdapterBankFullError, InferenceEngine, Request, Scheduler,
+    ServeMetrics, TokenStream, adapter_template, byte_vocab,
+    compile_json_schema, compile_regex, merge_adapter,
+)
+from dtdl_tpu.serve.tenant import init_bank
+
+MAX_SEQ = 48
+BUCKETS = (8, 16)
+RANK = 2
+N_ADAPTERS = 3          # row 0 = base, 2 loadable rows
+EOS = 63
+DIGITS = set(range(48, 58))     # byte_vocab(64) covers ASCII 0-63
+
+
+@pytest.fixture(scope="module")
+def obs():
+    # trace=True so the catalog events (adapter_loaded / grammar_violation
+    # / stream_delivery ...) are recorded and assertable; sentinel raises
+    # on ANY recompile of a watched program after its first compile.
+    return Observer(trace=True, sentinel="raise")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, attn_impl="dense", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return nn.unbox(model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))["params"])
+
+
+@pytest.fixture(scope="module")
+def adapters(params, tmp_path_factory):
+    """Three random-but-deterministic adapters saved through the real
+    (manifest-checked) checkpoint path: name -> (path, host tree)."""
+    tpl = adapter_template(params, rank=RANK)
+    base = tmp_path_factory.mktemp("adapters")
+    rng = np.random.default_rng(7)
+    out = {}
+    for name in ("A", "B", "C"):
+        tree = jax.tree_util.tree_map(
+            lambda x: np.asarray(rng.normal(0.0, 0.3, x.shape),
+                                 np.float32), tpl)
+        path = str(base / name)
+        save_weights(path, tree)
+        out[name] = (path, tree)
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine(model, params, obs):
+    return InferenceEngine(model, params, n_slots=2, buckets=BUCKETS,
+                           lora_rank=RANK, lora_adapters=N_ADAPTERS,
+                           observer=obs)
+
+
+@pytest.fixture(scope="module")
+def warm(engine):
+    """First-compile (prefill-8 + decode) in fixture setup, so no single
+    test absorbs the whole compile bill against the 10s discipline."""
+    Scheduler(engine, harvest_lag=2).run([Request([1, 2], 2)])
+    return engine
+
+
+def ref_greedy(model, params, prompt, n_new):
+    """One-at-a-time eager oracle (same shape as tests/test_serve.py)."""
+    cache = model.init_cache(1)
+    _, m = model.apply({"params": params, "cache": cache},
+                       jnp.asarray([prompt], jnp.int32), decode=True,
+                       mutable=["cache"])
+    logits = model.apply({"params": params},
+                         jnp.asarray([prompt], jnp.int32))
+    out = [int(jnp.argmax(logits[0, -1]))]
+    cache = m["cache"]
+    for _ in range(n_new - 1):
+        logits, m = model.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray([[out[-1]]], jnp.int32), decode=True,
+            mutable=["cache"])
+        cache = m["cache"]
+        out.append(int(jnp.argmax(logits[0, -1])))
+    return out
+
+
+def ref_constrained(model, params, prompt, n_new, dfa, eos):
+    """Eager masked oracle: the SAME per-step DFA mask the engine folds
+    into its sampler, applied to eager logits before every argmax."""
+    cache = model.init_cache(1)
+    _, m = model.apply({"params": params, "cache": cache},
+                       jnp.asarray([prompt], jnp.int32), decode=True,
+                       mutable=["cache"])
+    logits = model.apply({"params": params},
+                         jnp.asarray([prompt], jnp.int32))
+    cache = m["cache"]
+    lg = np.asarray(logits[0, -1], np.float32)
+    q, out = dfa.start, []
+    for _ in range(n_new):
+        t = int(np.argmax(np.where(dfa.mask(q), lg, -np.inf)))
+        out.append(t)
+        q = dfa.step(q, t)
+        assert q >= 0, "oracle emitted an illegal token"
+        if t == eos:
+            break
+        logits, m = model.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray([[t]], jnp.int32), decode=True,
+            mutable=["cache"])
+        cache = m["cache"]
+        lg = np.asarray(logits[0, -1], np.float32)
+    return out
+
+
+class OracleDraft:
+    """Drafts exactly the known continuation (from test_spec_decode.py):
+    every proposal is correct, so verify accepts maximally."""
+
+    def __init__(self, prompts, token_lists):
+        self.seqs = [(list(p), list(p) + list(t))
+                     for p, t in zip(prompts, token_lists)]
+
+    def propose(self, ctx, k):
+        ctx = [int(t) for t in np.asarray(ctx, np.int32)]
+        for p, full in self.seqs:
+            if ctx[:len(p)] == p and ctx == full[:len(ctx)]:
+                return np.asarray(full[len(ctx):len(ctx) + k], np.int32)
+        return np.zeros((0,), np.int32)
+
+
+class GarbageDraft:
+    """Proposes token 5 forever — NOT an ASCII digit (those are 48..57),
+    so under a \\d grammar every proposal is illegal and must be trimmed
+    host-side before dispatch."""
+
+    def propose(self, ctx, k):
+        return np.full((k,), 5, np.int32)
+
+
+def _trace_names(obs):
+    return [e.get("name") for e in obs.tracer.to_chrome()["traceEvents"]]
+
+
+# ---------------------------------------------------------------------------
+# pin 1: batched multi-LoRA == merged-weights solo decode
+# ---------------------------------------------------------------------------
+
+def test_lora_batched_identical_to_merged_solo(engine, model, params,
+                                               adapters, obs, warm):
+    """THE LoRA pin: two different adapters and a base request batched
+    through the same compiled steps, each token-identical to an eager
+    greedy decode with that adapter folded into the dense kernels."""
+    path_a, tree_a = adapters["A"]
+    path_b, tree_b = adapters["B"]
+    gen = np.random.default_rng(3)
+    p_a = gen.integers(0, 64, 3).tolist()
+    p_b = gen.integers(0, 64, 5).tolist()
+    p_0 = gen.integers(0, 64, 7).tolist()
+    sched = Scheduler(engine, harvest_lag=2)
+    r_a = sched.submit(Request(p_a, 8, adapter=path_a))
+    r_b = sched.submit(Request(p_b, 6, adapter=path_b))
+    r_0 = sched.submit(Request(p_0, 7))
+    sched.run()
+    for r in (r_a, r_b, r_0):
+        assert r.done and r.error is None, r.error
+    assert r_a.tokens == ref_greedy(model, merge_adapter(params, tree_a),
+                                    p_a, 8)
+    assert r_b.tokens == ref_greedy(model, merge_adapter(params, tree_b),
+                                    p_b, 6)
+    # row 0 is the all-zeros adapter: base traffic on the LoRA engine is
+    # bit-identical to the unadapted model
+    assert r_0.tokens == ref_greedy(model, params, p_0, 7)
+    m = sched.metrics.summary()
+    assert m["tokens_by_adapter"][path_a] == len(r_a.tokens)
+    assert m["tokens_by_adapter"][path_b] == len(r_b.tokens)
+    assert m["tokens_by_adapter"]["base"] == len(r_0.tokens)
+    # adapter identity is DATA: one decode program despite 3 tenants
+    assert engine.compile_stats()["decode"] == 1
+    assert "adapter_loaded" in _trace_names(obs)
+
+
+def test_lora_eviction_and_warm_reacquire(engine, model, params, adapters):
+    """With 2 loadable rows and A/B resident-unreferenced, adapter C
+    hot-loads by LRU-evicting; a back-to-back re-run of C is warm (no
+    reload) and still merged-oracle identical."""
+    bank = engine.adapter_bank
+    path_c, tree_c = adapters["C"]
+    evictions0 = bank.n_evictions
+    p = [9, 1, 4, 2]
+    r1 = Scheduler(engine, harvest_lag=2).run(
+        [Request(p, 6, adapter=path_c)])[0]
+    assert r1.error is None, r1.error
+    assert bank.n_evictions > evictions0      # somebody made room for C
+    loads0 = bank.n_loads
+    r2 = Scheduler(engine, harvest_lag=2).run(
+        [Request(p, 6, adapter=path_c)])[0]
+    assert r2.error is None and bank.n_loads == loads0   # warm hit
+    oracle = ref_greedy(model, merge_adapter(params, tree_c), p, 6)
+    assert r1.tokens == oracle and r2.tokens == oracle
+    assert engine.compile_stats()["decode"] == 1
+
+
+# ---------------------------------------------------------------------------
+# AdapterBank host registry (no engine)
+# ---------------------------------------------------------------------------
+
+def test_adapter_bank_refcount_lru_full(params, adapters):
+    bank = AdapterBank(init_bank(params, RANK, N_ADAPTERS),
+                       adapter_template(params, RANK))
+    pa, pb, pc = (adapters[n][0] for n in ("A", "B", "C"))
+    assert bank.acquire(None) == 0            # base row, never loaded
+    a = bank.acquire(pa)
+    b = bank.acquire(pb)
+    assert a != b and 0 not in (a, b)
+    assert bank.acquire(pa) == a and bank.refcount(pa) == 2
+    assert bank.n_loads == 2
+    # every row pinned: the error is NAMED, not a stall
+    with pytest.raises(AdapterBankFullError) as ei:
+        bank.acquire(pc)
+    assert pc in str(ei.value)
+    # release B to refcount 0 -> C evicts it (LRU among unreferenced)
+    bank.release(b)
+    c = bank.acquire(pc)
+    assert c == b and bank.n_evictions == 1
+    assert pb not in bank.resident() and pc in bank.resident()
+    assert bank.refcount(pb) == 0             # unknown -> 0, not KeyError
+    bank.release(0)                           # base release is a no-op
+    # A is still pinned twice and was never evicted
+    assert bank.refcount(pa) == 2 and bank.resident()[pa] == a
+
+
+def test_adapter_corrupt_checkpoint_fails_request(engine, adapters,
+                                                  tmp_path):
+    """A truncated adapter blob must surface as a named ``failed:``
+    request error through the manifest-integrity path — never silently
+    serve garbage — and must not poison the bank for later traffic."""
+    src = adapters["A"][0]
+    dst = str(tmp_path / "torn")
+    shutil.copy(src, dst)
+    shutil.copy(src + ".manifest.json", dst + ".manifest.json")
+    with open(dst, "r+b") as f:
+        f.truncate(os.path.getsize(dst) - 16)
+    loads0 = engine.adapter_bank.n_loads
+    r = Scheduler(engine, harvest_lag=2).run(
+        [Request([3, 1], 4, adapter=dst)])[0]
+    assert r.done and r.error is not None
+    assert r.error.startswith("failed:") and "corrupt" in r.error
+    assert engine.adapter_bank.n_loads == loads0
+    assert dst not in engine.adapter_bank.resident()
+
+
+def test_adapter_bank_full_sheds_request(engine, monkeypatch):
+    """Admission-time bank exhaustion sheds with the named error (the
+    scheduler must not block the batch waiting for a row)."""
+    def full(path):
+        raise AdapterBankFullError(path, N_ADAPTERS)
+    monkeypatch.setattr(engine.adapter_bank, "acquire", full)
+    sched = Scheduler(engine, harvest_lag=2)
+    r = sched.run([Request([2, 8], 4, adapter="nope")])[0]
+    assert r.done and r.error.startswith("shed:")
+    assert "adapter bank full" in r.error
+    assert sched.metrics.summary()["requests_shed"] == 1
+
+
+def test_submit_validation_rejects(engine, model, params):
+    plain = InferenceEngine(model, params, n_slots=2, buckets=BUCKETS)
+    r = Scheduler(plain).submit(Request([1, 2], 4, adapter="x"))
+    assert r.done and r.error.startswith("rejected:")
+    assert "adapter bank" in r.error
+
+    dfa = compile_regex(r"\d", byte_vocab(64), eos_id=EOS)
+    sched = Scheduler(engine)
+    r = sched.submit(Request([1, 2], 4, grammar=dfa))       # no eos_id
+    assert r.error.startswith("rejected:") and "eos_id" in r.error
+    r = sched.submit(Request([1, 2], 4, grammar=dfa, eos_id=7))
+    assert r.error.startswith("rejected:") and "eos_id" in r.error
+    wide = compile_regex(r"\d", byte_vocab(128), eos_id=EOS)
+    r = sched.submit(Request([1, 2], 4, grammar=wide, eos_id=EOS))
+    assert r.error.startswith("rejected:") and "vocab" in r.error
+
+
+# ---------------------------------------------------------------------------
+# token DFA compilation (pure host, no engine)
+# ---------------------------------------------------------------------------
+
+def test_regex_dfa_masks_and_walk():
+    dfa = compile_regex(r"\d\d", byte_vocab(64), eos_id=EOS)
+    assert dfa.start == 0 and dfa.eos_id == EOS
+    assert dfa.allow.shape[1] == 64 and dfa.nbytes() > 0
+    m0 = dfa.mask(dfa.start)
+    assert m0.shape == (64,) and m0.dtype == np.bool_
+    assert {t for t in range(64) if m0[t]} == DIGITS   # no EOS at start
+    q1 = dfa.step(dfa.start, 48)
+    assert q1 >= 0 and not dfa.accept[q1]
+    q2 = dfa.step(q1, 57)
+    assert q2 >= 0 and dfa.accept[q2]
+    # the accept state of an exhausted pattern legalizes ONLY eos
+    assert {t for t in range(64) if dfa.mask(q2)[t]} == {EOS}
+    assert dfa.step(dfa.start, 7) == -1                # illegal byte
+    assert dfa.walk([48, 57]) == q2
+    assert dfa.walk([48, 7]) == -1
+    # \d+ loops: its accept state allows digits AND eos
+    plus = compile_regex(r"\d+", byte_vocab(64), eos_id=EOS)
+    qa = plus.walk([50])
+    assert plus.accept[qa]
+    allowed = {t for t in range(64) if plus.mask(qa)[t]}
+    assert allowed == DIGITS | {EOS}
+
+
+def test_json_schema_dfa():
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"}},
+              "required": ["a"]}
+    eos = 127
+    dfa = compile_json_schema(schema, byte_vocab(128), eos_id=eos)
+    assert dfa.step(dfa.start, ord("{")) >= 0
+    assert dfa.step(dfa.start, ord("x")) == -1
+    # BFS the automaton for a shortest token path to an accepting
+    # state: it must spell a complete JSON object that legalizes eos
+    from collections import deque
+    came = {dfa.start: None}
+    frontier = deque([dfa.start])
+    goal = None
+    while frontier and goal is None:
+        q = frontier.popleft()
+        for t in map(int, np.flatnonzero(dfa.mask(q))):
+            if t == eos:
+                continue
+            nq = dfa.step(q, t)
+            assert nq >= 0, "mask legalized a dead transition"
+            if nq not in came:
+                came[nq] = (q, t)
+                if dfa.accept[nq] and dfa.mask(nq)[eos]:
+                    goal = nq
+                    break
+                frontier.append(nq)
+    assert goal is not None, "never reached an accepting state"
+    emitted, q = [], goal
+    while came[q] is not None:
+        q, t = came[q]
+        emitted.append(t)
+    emitted.reverse()
+    assert dfa.walk(emitted) == goal
+    text = "".join(chr(t) for t in emitted)
+    assert text.startswith("{") and '"a"' in text and text.endswith("}")
+
+
+# ---------------------------------------------------------------------------
+# pin 2: constrained decoding == eager masked oracle
+# ---------------------------------------------------------------------------
+
+def test_constrained_identical_to_masked_oracle(engine, model, params):
+    dfa = compile_regex(r"\d+", byte_vocab(64), eos_id=EOS)
+    prompt = [7, 2, 11]
+    r = Scheduler(engine, harvest_lag=3).run(
+        [Request(prompt, 10, eos_id=EOS, grammar=dfa)])[0]
+    assert r.error is None, r.error
+    oracle = ref_constrained(model, params, prompt, 10, dfa, EOS)
+    assert r.tokens == oracle
+    body = r.tokens[:-1] if r.tokens[-1] == EOS else r.tokens
+    assert body and all(t in DIGITS for t in body)
+
+
+def test_constrained_mask_forces_termination(engine, obs):
+    """After ``\\d\\d`` is exhausted only EOS is legal: the request must
+    stop at exactly 3 tokens regardless of its 12-token budget."""
+    dfa = compile_regex(r"\d\d", byte_vocab(64), eos_id=EOS)
+    r = Scheduler(engine, harvest_lag=2).run(
+        [Request([5, 3], 12, eos_id=EOS, grammar=dfa)])[0]
+    assert r.error is None, r.error
+    assert len(r.tokens) == 3 and r.tokens[-1] == EOS
+    assert all(t in DIGITS for t in r.tokens[:2])
+
+
+def test_constrained_speculation_identical(engine, model, params):
+    """Speculation under a grammar is lossless: an oracle draft is
+    accepted (verify engages, all k+1 positions masked) and a garbage
+    draft is trimmed host-side — both produce the reference tokens."""
+    dfa = compile_regex(r"\d+", byte_vocab(64), eos_id=EOS)
+    prompt = [7, 2, 11]
+    ref = ref_constrained(model, params, prompt, 10, dfa, EOS)
+
+    s1 = Scheduler(engine, harvest_lag=3,
+                   draft=OracleDraft([prompt], [ref]))
+    r1 = s1.run([Request(prompt, 10, eos_id=EOS, grammar=dfa,
+                         speculate=4)])[0]
+    assert r1.error is None and r1.tokens == ref
+    m1 = s1.metrics.summary()
+    assert m1["spec_steps"] > 0, "speculation never engaged"
+    assert m1["spec_accepted_tokens"] > 0
+
+    s2 = Scheduler(engine, harvest_lag=3, draft=GarbageDraft())
+    r2 = s2.run([Request(prompt, 10, eos_id=EOS, grammar=dfa,
+                         speculate=4)])[0]
+    assert r2.error is None and r2.tokens == ref
+    m2 = s2.metrics.summary()
+    assert m2["grammar_rejected_tokens"] > 0, \
+        "illegal drafts were not trimmed"
+
+
+def test_constrained_chunked_prefill_identical(engine, model, params):
+    """A prompt past the largest bucket enters in chunks riding the
+    verify program; only the FINAL chunk's bonus sample is a real first
+    token, and it must come out masked."""
+    dfa = compile_regex(r"\d+", byte_vocab(64), eos_id=EOS)
+    gen = np.random.default_rng(11)
+    prompt = gen.integers(0, 64, 14).tolist()
+    sched = Scheduler(engine, harvest_lag=2, chunk_tokens=4)
+    r = sched.run([Request(prompt, 8, eos_id=EOS, grammar=dfa)])[0]
+    assert r.error is None, r.error
+    assert sched.metrics.summary()["prefill_chunks"] >= 2
+    assert r.tokens == ref_constrained(model, params, prompt, 8, dfa, EOS)
+
+
+# ---------------------------------------------------------------------------
+# TokenStream protocol (pure host)
+# ---------------------------------------------------------------------------
+
+def test_stream_ownership_and_prefix_guard():
+    s = TokenStream()
+    assert s.offer(1, [10, 11]) == 2          # first offerer claims
+    assert s.offer(2, [10, 11, 12]) == 0      # non-owner delivers nothing
+    assert s.tokens == [10, 11]
+    assert s.offer(1, [10, 11]) == 0          # no extension, no delivery
+    assert s.offer(1, [10, 11, 12, 13]) == 2  # prefix-guarded extension
+    s.drop(2)                                 # non-owner drop: no-op
+    assert s.offer(1, [10, 11, 12, 13, 14]) == 1
+    s.drop(1)                                 # owner errored out
+    assert s.offer(3, [10, 11, 12, 13, 14, 15]) == 1   # successor catches up
+    # a successor whose history disagrees marks divergence, delivers 0
+    assert s.offer(3, [99]) == 0 and s.divergent
+    assert s.tokens == [10, 11, 12, 13, 14, 15]
+
+
+def test_stream_finish_reconciles_and_closes():
+    got = []
+    s = TokenStream(callback=got.append)
+    s.offer(1, [4, 5])
+    assert s.finish([4, 5, 6, 7]) == 2        # remaining suffix delivered
+    assert s.closed and s.error is None
+    assert s.offer(1, [4, 5, 6, 7, 8]) == 0   # closed: every offer is 0
+    assert s.finish([1]) == 0                 # double-finish is a no-op
+    assert s.tokens == [4, 5, 6, 7]
+    assert got == [[4, 5], [6, 7]]
+    assert list(s) == [4, 5, 6, 7]            # iterator drains then ends
+    e = TokenStream()
+    e.offer(1, [2])
+    assert e.finish([2, 3], error="failed: boom") == 0
+    assert e.closed and e.error == "failed: boom"
+    assert e.tokens == [2]                    # error finish delivers nothing
+
+
+# ---------------------------------------------------------------------------
+# pin 3: streamed tokens == final Request.tokens
+# ---------------------------------------------------------------------------
+
+def test_stream_identical_to_final_tokens(engine, obs):
+    """Incremental deliveries arrive across multiple harvest windows,
+    every snapshot is a prefix of the final sequence, and the closed
+    stream equals ``Request.tokens`` exactly."""
+    snaps = []
+    stream = TokenStream(callback=lambda new: snaps.append(len(new)))
+    gen = np.random.default_rng(5)
+    prompt = gen.integers(0, 64, 6).tolist()
+    sched = Scheduler(engine, harvest_lag=2, observer=obs)
+    r = sched.run([Request(prompt, 9, stream=stream)])[0]
+    assert r.error is None, r.error
+    assert stream.closed and not stream.divergent
+    assert stream.tokens == r.tokens and len(r.tokens) == 9
+    assert len(snaps) >= 2, "delivery was not incremental"
+    assert sum(snaps) == 9
+    assert sched.metrics.summary()["stream_deliveries"] >= len(snaps) - 1
+    assert "stream_delivery" in _trace_names(obs)
+
+
+def test_stream_with_grammar_and_eos(engine):
+    """Streaming composes with constrained decoding: the delivered
+    sequence is the masked sequence, including the EOS terminal."""
+    dfa = compile_regex(r"\d\d\d\d", byte_vocab(64), eos_id=EOS)
+    stream = TokenStream()
+    r = Scheduler(engine, harvest_lag=2).run(
+        [Request([7, 2], 12, eos_id=EOS, grammar=dfa, stream=stream)])[0]
+    assert r.error is None, r.error
+    assert stream.closed and stream.tokens == r.tokens
+    assert len(r.tokens) == 5 and r.tokens[-1] == EOS
+
+
+# ---------------------------------------------------------------------------
+# metrics plumbing
+# ---------------------------------------------------------------------------
+
+def test_window_flattens_adapter_dict():
+    """Dict-valued window counters export as per-key flat deltas —
+    exporter series points stay scalar."""
+    m = ServeMetrics()
+    m.on_adapter_tokens("t1", 3)
+    m.on_adapter_tokens("base", 2)
+    w = m.window()
+    assert w["tokens_by_adapter.t1"] == 3
+    assert w["tokens_by_adapter.base"] == 2
+    m.on_adapter_tokens("t1", 4)
+    w = m.window()
+    assert w["tokens_by_adapter.t1"] == 4      # delta, not cumulative
+    assert w.get("tokens_by_adapter.base", 0) == 0
+    m.on_grammar_reject(5)
+    m.on_stream(2)
+    w = m.window()
+    assert w["grammar_rejected_tokens"] == 5
+    assert w["stream_deliveries"] == 2
+
+
+def test_zero_new_program_families(engine, obs):
+    """Module-level compile census: after every traffic mix above (LoRA
+    x3, grammar, speculation, chunked prefill, streams) the engine holds
+    ONE decode program, one prefill per touched bucket, one verify per
+    k — and the raise-sentinel never fired."""
+    stats = engine.compile_stats()
+    assert stats["decode"] == 1
+    assert all(v == 1 for v in stats["prefill"].values())
+    assert all(v == 1 for v in stats["verify"].values())

@@ -6,6 +6,7 @@ import numpy as np
 
 from dtdl_tpu.models import resnet50
 from dtdl_tpu.models.resnet import SpaceToDepthStem
+import pytest
 
 
 def test_s2d_stem_matches_7x7_conv_exactly():
@@ -40,6 +41,7 @@ def test_s2d_stem_grads_flow_to_7x7_kernel():
     assert float(jnp.min(jnp.sum(jnp.abs(g), axis=(2, 3)))) > 0.0
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_resnet_forward_shapes_odd_input_falls_back():
     """Odd spatial dims can't space-to-depth; the standard conv path runs.
     A one-block-per-stage ResNet keeps this a sub-second check — the stem

@@ -58,6 +58,7 @@ def test_modeled_scaling_4d_anchor_and_structure():
     assert moe["1,1,4,1"]["comm_ms"]["moe"] == 0.0
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_scaling_section_emits_headline_rows_and_sanity():
     rows = [{"model": "pyramidnet", "batch_size": 256, "step_time_ms": 63.8},
             {"model": "lm", "size": "base", "seq": 4096, "batch_size": 8,

@@ -46,6 +46,7 @@ def test_moe_runs_and_sows_aux_loss():
     assert aux and all(float(a) >= 0 for a in aux)
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_routed_moe_matches_dense_when_nothing_drops():
     """Routed capacity dispatch computes the identical function to the
     dense one-hot oracle when no token can be dropped (capacity_factor =
@@ -256,6 +257,7 @@ def test_chunked_lm_loss_matches_dense(V, chunk):
                                    atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_lm_step_vocab_chunked_matches_dense(devices):
     """make_lm_train_step(vocab_chunk_size=..) produces the same update and
     metrics as the dense head on the tiny model."""
@@ -447,6 +449,7 @@ def test_decode_prefill_then_step_matches_all_steps():
                                np.asarray(ref[:, 7]), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_generate_greedy_and_sampled():
     """generate(): greedy decode is deterministic, continues the prompt,
     respects max_seq, and equals the naive no-cache argmax loop."""
@@ -492,6 +495,7 @@ def test_generate_greedy_and_sampled():
         generate(model, params, prompt, 0)
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_generate_data_parallel_token_identical(devices):
     """Batch-sharded decode under DataParallel: the 8-replica run must
     produce TOKEN-IDENTICAL output to the single-device run — greedy and

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 
 from dtdl_tpu.utils.profiling import maybe_trace, step_annotation
 from dtdl_tpu.utils.timing import StepTimer, fmt_timedelta
+import pytest
 
 
 def test_step_timer_tracks_steps_and_blocks():
@@ -29,6 +30,7 @@ def test_fmt_timedelta():
     assert fmt_timedelta(3661.9) == "1:01:01"
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_maybe_trace_noop_and_capture(tmp_path):
     with maybe_trace(None):          # falsy dir: no-op, no files
         jnp.sum(jnp.arange(4.0)).block_until_ready()
@@ -45,6 +47,7 @@ def test_step_annotation_without_active_trace_is_cheap():
         jnp.sum(jnp.arange(4.0)).block_until_ready()
 
 
+@pytest.mark.slow   # tier-1 budget-discipline cut (round 22)
 def test_tensorboard_sink_writes_or_degrades(tmp_path):
     """TensorBoardSink writes event files when torch's SummaryWriter is
     available (it is in this image) and must never raise when closing."""
