@@ -784,6 +784,144 @@ def bench_paged(size: str = "small", n_slots: int = 4,
     return out
 
 
+def bench_kv_hierarchy(size: str = "small", page_size: int = 64,
+                       new_tokens: int = 8) -> dict:
+    """Hierarchical KV cache row (round 23 acceptance).
+
+    One shared-system-prompt request measured at every tier of the
+    hierarchy: **cold** (full prefill, the price the cache avoids),
+    **HBM hit** (the round-6 prefix cache: suffix-only prefill),
+    **host hit** (the pages were evicted to the host-DRAM spill store
+    and re-enter via the batched inject path), **disk hit** (host
+    budget of one byte forces every spill through the checksummed
+    mmap file).  The claim the row must carry: restore beats
+    recompute — ``ttft_s_host_hit < ttft_s_cold`` at 'small' scale,
+    because injecting ~0.5 MB/page over PCIe/DRAM is cheaper than
+    recomputing ~0.8k tokens of prefill FLOPs (break-even priced in
+    SCALING.md "Memory hierarchy arithmetic").  Eviction is forced
+    the honest way — a bounded page pool plus distinct-content churn
+    traffic — not by poking allocator internals, so the row exercises
+    the same spill-on-evict path production would.
+
+    The fleet half is a correctness drill, not a throughput number:
+    a two-replica Router with the prefix directory on, one replica
+    killed mid-traffic — requests_lost must be 0 and every token
+    identical to a ``prefix_directory=False`` oracle fleet (the
+    directory may only change WHERE work runs, never what it emits).
+    """
+    import tempfile
+
+    import flax.linen as nn
+    from dtdl_tpu.models import transformer_lm
+    from dtdl_tpu.resil import FaultPlan
+    from dtdl_tpu.resil.faults import replica_site
+    from dtdl_tpu.serve import InferenceEngine, Request, Router, Scheduler
+
+    model = transformer_lm(size, attn_impl="dense", dtype=jnp.float32)
+    params = nn.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    rng = np.random.default_rng(0)
+    n_sys = (3 * model.max_seq // 4) // page_size * page_size
+    n_sys_pages = n_sys // page_size
+    system = rng.integers(0, model.vocab_size, n_sys).tolist()
+    suffix = lambda: rng.integers(0, model.vocab_size,
+                                  page_size // 2).tolist()
+    churn_prompt = lambda: rng.integers(0, model.vocab_size,
+                                        n_sys + page_size // 2).tolist()
+    # pool = cached system pages + one in-flight churn request, minus a
+    # deficit that forces the allocator to evict (and thus spill) —
+    # two churn waves push the WHOLE system chain out of HBM
+    per_req = n_sys_pages + 2
+    engine = InferenceEngine(model, params, n_slots=2,
+                             page_size=page_size,
+                             n_pages=n_sys_pages + per_req + 2)
+    host_budget = 64 << 20
+
+    def ttft(sched, prompt):
+        r = Request(prompt, new_tokens)
+        sched.run([r])
+        assert r.error is None, r.error
+        return round(r.t_first - r.t_submit, 6)
+
+    def churn(sched, waves=2):
+        for _ in range(waves):
+            sched.run([Request(churn_prompt(), new_tokens)])
+
+    def phases(**spill_kw):
+        s = Scheduler(engine, harvest_lag=1, **spill_kw)
+        cold = ttft(s, system + suffix())
+        hbm = ttft(s, system + suffix())
+        churn(s)
+        hot = ttft(s, system + suffix())
+        return cold, hbm, hot, s.metrics.summary()
+
+    # warmup: one full cycle compiles every bucket + the extract/inject
+    # variants, so the timed phases below measure work, not compiles
+    phases(spill_host_bytes=host_budget)
+
+    cold, hbm, host_hit, m = phases(spill_host_bytes=host_budget)
+    with tempfile.TemporaryDirectory() as tmp:
+        _, _, disk_hit, md = phases(spill_host_bytes=1, spill_dir=tmp,
+                                    spill_disk_bytes=1 << 30)
+
+    row = {
+        "model": "kv_hierarchy", "size": size, "page_size": page_size,
+        "system_tokens": n_sys, "new_tokens": new_tokens,
+        "ttft_s_cold": cold,
+        "ttft_s_hbm_hit": hbm,
+        "ttft_s_host_hit": host_hit,
+        "ttft_s_disk_hit": disk_hit,
+        "restore_beats_recompute": host_hit < cold,
+        "kv_spill_pages_spilled": m["pages_spilled"],
+        "kv_spill_pages_restored": m["pages_restored"],
+        "kv_spill_bytes": m["spill_bytes"],
+        "kv_spill_restore_s": m["restore_s"],
+        "kv_spill_host_hits": m["spill_host_hits"],
+        "kv_spill_disk_hits": md["spill_disk_hits"],
+    }
+
+    # --- fleet prefix-directory drill (tiny model: correctness only) --
+    tiny = transformer_lm("tiny", vocab_size=64, d_model=32, n_layers=2,
+                          n_heads=2, d_ff=64, max_seq=48,
+                          attn_impl="dense", dtype=jnp.float32)
+    tparams = nn.unbox(tiny.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"])
+    teng = InferenceEngine(tiny, tparams, n_slots=2, buckets=(8, 16),
+                           page_size=8)
+    sys9 = list(range(1, 10))
+    reqs = lambda: [Request(sys9 + [20 + i, 21 + i], 4)
+                    for i in range(6)]
+    fkw = dict(sched_kwargs={"harvest_lag": 1}, retry_budget=3,
+               probe_interval_s=0.01, watchdog_s=0.15)
+    with Router(teng, n_replicas=2, prefix_directory=False,
+                **fkw) as off:
+        off.run(reqs())
+        want = [r.tokens for r in off.run(reqs())]
+    plan = FaultPlan().at(replica_site(0, "loop"), 0)
+    with Router(teng, n_replicas=2, plan=plan, auto_restart=True,
+                **fkw) as router:
+        router.run(reqs())                 # replica 0 dies mid-wave
+        time.sleep(0.05)
+        wave2 = router.run(reqs())
+        fs = router.summary()
+    row.update({
+        "prefix_directory_hits": fs["fleet_directory_hits"],
+        "prefix_directory_tokens_saved":
+            fs["fleet_directory_tokens_saved"],
+        "prefix_directory_invalidations":
+            fs["fleet_directory_invalidations"],
+        "prefix_directory_requests_lost":
+            0 if fs["fleet_accounting_ok"]
+            and fs["fleet_requests_failed"] == 0
+            and fs["fleet_requests_expired"] == 0
+            else fs["fleet_requests_failed"] + fs["fleet_requests_expired"],
+        "prefix_directory_token_divergence": sum(
+            1 for r, w in zip(wave2, want) if r.tokens != w),
+        "prefix_directory_evictions": fs["fleet_evictions"],
+    })
+    return row
+
+
 def bench_chunked_prefill(size: str = "small", n_slots: int = 4,
                           chunk_tokens: int = 4,
                           new_tokens: int = 32) -> dict:
@@ -1862,6 +2000,10 @@ def main(argv=None) -> dict:
                         "(p99 inter-token latency with/without "
                         "chunking under mixed long-prompt traffic + "
                         "the disaggregated-fleet handoff receipt)")
+    p.add_argument("--skip-kv-hierarchy", action="store_true",
+                   help="skip the hierarchical KV cache row "
+                        "(cold/HBM/host/disk TTFT per tier + the "
+                        "fleet prefix-directory kill drill)")
     p.add_argument("--skip-observability", action="store_true",
                    help="skip the observability-overhead (tracer on vs "
                         "off steps/sec) row")
@@ -2089,6 +2231,18 @@ def main(argv=None) -> dict:
                            "error": f"{type(e).__name__}: {e}"[:200]}
         records.append(chunked_row)
         print("  " + json.dumps(chunked_row), file=sys.stderr, flush=True)
+
+    kvh_row = None
+    if not a.skip_kv_hierarchy:
+        # hierarchical KV cache row (round 23): TTFT at every tier of
+        # the spill hierarchy + the fleet prefix-directory kill drill
+        try:
+            kvh_row = bench_kv_hierarchy()
+        except Exception as e:  # the kv row must never sink the bench
+            kvh_row = {"model": "kv_hierarchy",
+                       "error": f"{type(e).__name__}: {e}"[:200]}
+        records.append(kvh_row)
+        print("  " + json.dumps(kvh_row), file=sys.stderr, flush=True)
 
     mt_row = None
     if not a.skip_multitenant:

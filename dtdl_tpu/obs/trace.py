@@ -137,6 +137,13 @@ EVENT_CATALOG = frozenset({
     # and incremental TokenStream deliveries at harvest boundaries
     "adapter_loaded", "adapter_evicted", "grammar_violation",
     "stream_delivery",
+    # hierarchical KV cache (round 23): a batch of evicted pages
+    # spilled to the host/disk tiers, a prefix-miss served back out of
+    # them, and the fleet prefix directory's routing/consistency edges
+    # (a hit = affinity beat least-loaded; an invalidation = a replica
+    # eviction/drain/containment delisted its advertised pages)
+    "page_spilled", "page_restored", "prefix_directory_hit",
+    "prefix_directory_invalidated",
 })
 
 

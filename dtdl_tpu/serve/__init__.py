@@ -49,6 +49,19 @@ streaming at the lag-harvest boundaries:
                          grammar=dfa, eos_id=eos,
                          stream=TokenStream()))
 
+A **hierarchical KV cache** (round 23) extends the prefix cache past
+HBM: evicted refcount-0 cached pages spill through the batched
+extract path into a bounded host-DRAM store (and overflow onward to a
+checksummed mmap'd disk file), a prefix miss restores them via inject
+instead of recomputing, and the Router keeps a fleet-wide chain-hash →
+replica prefix directory so warm-prefix traffic routes to the replica
+already holding the pages:
+
+    sched = Scheduler(engine, spill_host_bytes=1 << 30,
+                      spill_dir="/var/kv", spill_disk_bytes=16 << 30)
+    router = Router(engine, n_replicas=2,
+                    sched_kwargs=dict(spill_host_bytes=1 << 30))
+
 See engine.py (the compiled-program contract), scheduler.py (slot-based
 continuous batching + spec integration), paged.py (page allocator +
 radix-style prefix cache), draft.py (draft sources), sampling.py
@@ -65,7 +78,7 @@ from dtdl_tpu.serve.engine import (  # noqa: F401
     InferenceEngine, PromptTooLongError, default_buckets,
 )
 from dtdl_tpu.serve.fleet import (  # noqa: F401
-    FleetMetrics, Replica, Router, default_fleet_slos,
+    FleetMetrics, PrefixDirectory, Replica, Router, default_fleet_slos,
 )
 from dtdl_tpu.serve.health import (  # noqa: F401
     DRAINING, EVICTED, HEALTHY, SUSPECT, ReplicaHealth,
@@ -74,11 +87,12 @@ from dtdl_tpu.serve.metrics import (  # noqa: F401
     ERROR_KINDS, UNAVAILABLE_KINDS, ServeMetrics, error_kind,
 )
 from dtdl_tpu.serve.paged import (  # noqa: F401
-    GARBAGE_PAGE, PageAllocator, PagePoolExhaustedError,
+    GARBAGE_PAGE, DiskPageStore, HostPageStore, PageAllocator,
+    PagePoolExhaustedError, SpillCorruptEntryError, page_chain_hashes,
 )
 from dtdl_tpu.serve.sampling import (  # noqa: F401
     GREEDY, SampleParams, accept_resample, filter_logits,
-    filter_logits_sorted, sample,
+    filter_logits_sorted, mask_words, pack_mask, sample, unpack_mask,
 )
 from dtdl_tpu.serve.scheduler import Request, Scheduler  # noqa: F401
 from dtdl_tpu.serve.tenant import (  # noqa: F401

@@ -83,7 +83,8 @@ from dtdl_tpu.ops.paged_attention import paged_kernel_enabled
 from dtdl_tpu.quant import (Fp8UnsupportedError, canon_kv_dtype,
                             canon_weight_quant, quantize_params, tree_bytes)
 from dtdl_tpu.serve.sampling import (FILTER_IMPL, SampleParams,
-                                     accept_resample, pack, sample)
+                                     accept_resample, mask_words, pack,
+                                     pack_mask, sample)
 
 
 class PromptTooLongError(ValueError):
@@ -334,10 +335,19 @@ class InferenceEngine:
         # neutral per-call tenant inputs, allocated once: the all-zeros
         # adapter-id vector and all-true grammar masks keep every
         # unconstrained dispatch bit-identical to the pre-tenant
-        # programs WITHOUT re-uploading [B(, k+1), V] arrays per step
+        # programs WITHOUT re-uploading per-step arrays.  Masks travel
+        # PACKED (round 23): uint32 bitset words, ceil(V/32) per row —
+        # 8x fewer host->device bytes than the dense [*, V] bools, which
+        # the programs expand on device (sampling.unpack_mask).  Every
+        # dispatch packs, so the compiled signature is always uint32 and
+        # constrained/unconstrained traffic share one program.
         self._zero_aids = jnp.zeros((n_slots,), jnp.int32)
-        self._ones_decode = jnp.ones((n_slots, model.vocab_size), bool)
-        self._ones_prefill = jnp.ones((1, model.vocab_size), bool)
+        self._mask_words = mask_words(model.vocab_size)
+        _full = np.uint32(0xFFFFFFFF)
+        self._ones_decode = jnp.full((n_slots, self._mask_words), _full,
+                                     jnp.uint32)
+        self._ones_prefill = jnp.full((1, self._mask_words), _full,
+                                      jnp.uint32)
         self._ones_verify: dict[int, object] = {}
         # obs facade: when set (directly or by the Scheduler), the
         # recompile sentinel wraps each compiled program — a retrace of
@@ -830,7 +840,7 @@ class InferenceEngine:
         key = jax.random.PRNGKey(0) if key is None else key
         aid, lora = self._lora_args(adapter_id, scalar=True)
         allowed = (self._ones_prefill if allowed is None
-                   else jnp.asarray(allowed, bool))
+                   else jnp.asarray(pack_mask(allowed)))
         if self.paged:
             arena, last, logits = self._prefill_fns[T](
                 self.params, arena, last_tokens, jnp.asarray(padded),
@@ -878,7 +888,7 @@ class InferenceEngine:
             self._decode_fn = fn
         aids, lora = self._lora_args(adapter_ids)
         allowed = (self._ones_decode if allowed is None
-                   else jnp.asarray(allowed, bool))
+                   else jnp.asarray(pack_mask(allowed)))
         return self._decode_fn(self.params, arena, last_tokens,
                                jnp.asarray(active),
                                self._tables_arg(page_tables), key,
@@ -946,11 +956,12 @@ class InferenceEngine:
         aids, lora = self._lora_args(adapter_ids)
         if allowed is None:
             if k not in self._ones_verify:
-                self._ones_verify[k] = jnp.ones(
-                    (B, k + 1, self.model.vocab_size), bool)
+                self._ones_verify[k] = jnp.full(
+                    (B, k + 1, self._mask_words),
+                    np.uint32(0xFFFFFFFF), jnp.uint32)
             allowed = self._ones_verify[k]
         else:
-            allowed = jnp.asarray(allowed, bool)
+            allowed = jnp.asarray(pack_mask(allowed))
         return self._verify_fns[k](
             self.params, arena, last_tokens, draft_tokens,
             jnp.asarray(draft_len, jnp.int32), jnp.asarray(active),
@@ -1081,3 +1092,54 @@ class InferenceEngine:
             jnp.asarray(ids), jnp.asarray(slot, jnp.int32),
             jnp.asarray(index, jnp.int32),
             jnp.asarray(first_token, jnp.int32))
+
+    def extract_pages_batch(self, arena, page_ids):
+        """Export ANY number of pages in ONE host sync — the spill-on-
+        evict primitive (round 23).  ``page_ids`` is chunked into
+        ``n_ptab``-wide dispatches of the SAME compiled gather as
+        :meth:`extract_pages` (fixed ``[n_ptab]`` id shape — zero new
+        program families), every chunk is dispatched before anything is
+        read, and a single ``jax.device_get`` collects them all: the
+        sync cost of spilling N evicted pages is one round trip, not N.
+        Returns a host pytree mirroring the pool-leaf structure, each
+        leaf ``[len(page_ids), ...]`` in input order."""
+        if not self.paged:
+            raise ValueError("KV handoff requires a paged engine "
+                             "(page_size > 0)")
+        n = len(page_ids)
+        if n < 1:
+            raise ValueError("need at least one page id")
+        if self._extract_fn is None:
+            fn = self._build_extract()
+            if self.observer is not None:
+                fn = self.observer.watch(fn, "serve.kv_extract")
+            self._extract_fn = fn
+        futs = []
+        for i in range(0, n, self.n_ptab):
+            chunk = page_ids[i:i + self.n_ptab]
+            ids = np.zeros(self.n_ptab, np.int32)  # pad -> garbage page 0
+            ids[:len(chunk)] = chunk
+            futs.append(self._extract_fn(arena, jnp.asarray(ids)))
+        # audit: ok[host-sync-get] the ONE deliberate sync of a batched spill (all chunks dispatched above; metered as spill_s)
+        host = jax.device_get(futs)
+        trimmed = [jax.tree.map(
+            lambda a, m=min(self.n_ptab, n - i): a[:m], out)
+            for i, out in zip(range(0, n, self.n_ptab), host)]
+        if len(trimmed) == 1:
+            return trimmed[0]
+        return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0),
+                            *trimmed)
+
+    def inject_pages_batch(self, arena, last_tokens, items):
+        """Adopt several extracted page groups — ``items`` of ``(data,
+        page_ids, slot, index, first_token)`` — in one dispatch-only
+        pass: every group rides the SAME compiled scatter as
+        :meth:`inject_pages` (the donated arena threads through), and
+        since inject was never the sync side of the handoff there are
+        ZERO host syncs here regardless of group count.  Returns
+        ``(arena, last_tokens)``."""
+        for data, page_ids, slot, index, first_token in items:
+            arena, last_tokens = self.inject_pages(
+                arena, last_tokens, data, page_ids, slot, index,
+                first_token)
+        return arena, last_tokens

@@ -76,6 +76,7 @@ from dtdl_tpu.serve.health import (DRAINING, EVICTED, HEALTHY, SUSPECT,
                                    ReplicaHealth)
 from dtdl_tpu.serve.metrics import (UNAVAILABLE_KINDS, ServeMetrics,
                                     _window_delta, error_kind)
+from dtdl_tpu.serve.paged import page_chain_hashes
 from dtdl_tpu.serve.scheduler import Request, Scheduler
 
 
@@ -323,6 +324,67 @@ class Replica:
             self._on_complete()
 
 
+class PrefixDirectory:
+    """Fleet-wide chain-hash → replica map (round 23).
+
+    Fed by replica **receipts** (:attr:`Scheduler.kv_receipts`): every
+    page a replica registers in its prefix cache — or restores/spills
+    through its host/disk tiers — publishes ``("add", hash)``; a page
+    dropped from the LAST spill tier publishes ``("drop", hash)``; a
+    containment publishes ``("reset", 0)``.  The Router drains receipts
+    once per pump tick and consults :meth:`lookup` at dispatch, so a
+    request whose warm system prompt lives on replica 3 is routed to
+    replica 3 instead of the least-loaded replica — turning a fleet of
+    N independent prefix caches into one logical cache.
+
+    Ownership is **last-writer-wins** per hash (the newest copy is the
+    one most recently touched, hence least likely to be evicted), and
+    the whole structure is advisory: a stale entry routes a request to
+    a replica that no longer holds the prefix, which then recomputes —
+    strictly a perf miss, never wrong tokens, because the replica's own
+    chain-hash-verified prefix cache is the only authority over page
+    CONTENT.  That is why eviction/drain/containment can invalidate
+    with a plain bulk drop and no coordination."""
+
+    def __init__(self):
+        self._owner: dict[int, int] = {}      # chain hash -> replica idx
+
+    def add(self, h: int, replica: int) -> None:
+        self._owner[h] = replica
+
+    def drop(self, h: int, replica: int) -> None:
+        # only the advertised owner may retract: replica A dropping its
+        # spill copy must not delist replica B's live copy
+        if self._owner.get(h) == replica:
+            del self._owner[h]
+
+    def invalidate_replica(self, replica: int) -> int:
+        """Bulk-drop every entry owned by ``replica`` (eviction, drain,
+        containment); returns how many entries went."""
+        stale = [h for h, r in self._owner.items() if r == replica]
+        for h in stale:
+            del self._owner[h]
+        return len(stale)
+
+    def lookup(self, hashes: Sequence[int]) -> tuple[Optional[int], int]:
+        """Longest single-owner run from the START of the chain —
+        ``(replica, n_pages)``, or ``(None, 0)`` on a cold prefix.  A
+        prefix split across two replicas only credits the first owner:
+        chain hashes mean page k is useless without pages 0..k-1, so
+        only a run anchored at the root saves recompute."""
+        owner, n = None, 0
+        for h in hashes:
+            r = self._owner.get(h)
+            if r is None or (owner is not None and r != owner):
+                break
+            owner = r
+            n += 1
+        return owner, n
+
+    def __len__(self) -> int:
+        return len(self._owner)
+
+
 def _merge_counts(dicts) -> dict:
     """Key-wise sum of count dicts (per-tenant rollups across
     replicas)."""
@@ -372,6 +434,14 @@ class FleetMetrics:
         # the nested ServeMetrics as kv_handoff_pages/kv_handoff_s)
         self.migrations = 0
         self.kv_handoff_pages = 0
+        # hierarchical KV cache (round 23): prefix-directory routing —
+        # affinity dispatches that beat least-loaded, the prefill
+        # tokens they saved, and bulk invalidations on replica
+        # eviction/drain/containment (spill/restore volume itself is a
+        # per-replica ServeMetrics book, rolled up in summary())
+        self.directory_hits = 0
+        self.directory_tokens_saved = 0
+        self.directory_invalidations = 0
         self.ttft_hist = LogHistogram()
         self.tok_latency_hist = LogHistogram()
         self._t_start: Optional[float] = None
@@ -438,6 +508,15 @@ class FleetMetrics:
         self.migrations += 1
         self.kv_handoff_pages += pages
 
+    def on_directory_hit(self, tokens_saved: int):
+        """One dispatch where prefix affinity overrode least-loaded,
+        expecting ``tokens_saved`` prefill tokens served from cache."""
+        self.directory_hits += 1
+        self.directory_tokens_saved += tokens_saved
+
+    def on_directory_invalidate(self, n_entries: int):
+        self.directory_invalidations += n_entries
+
     # ---- aggregation --------------------------------------------------
 
     @property
@@ -483,6 +562,20 @@ class FleetMetrics:
                 r.get("grammar_rejected_tokens", 0) for r in replicas),
             "fleet_stream_deliveries": sum(
                 r.get("stream_deliveries", 0) for r in replicas),
+            # hierarchical KV cache (round 23): spill/restore volume
+            # rolled up from the replica books + the router's own
+            # directory ledgers
+            "fleet_pages_spilled": sum(
+                r.get("pages_spilled", 0) for r in replicas),
+            "fleet_pages_restored": sum(
+                r.get("pages_restored", 0) for r in replicas),
+            "fleet_spill_bytes": sum(
+                r.get("spill_bytes", 0) for r in replicas),
+            "fleet_restore_s": round(sum(
+                r.get("restore_s", 0.0) for r in replicas), 6),
+            "fleet_directory_hits": self.directory_hits,
+            "fleet_directory_tokens_saved": self.directory_tokens_saved,
+            "fleet_directory_invalidations": self.directory_invalidations,
             # the mean keys stay present under zero traffic (same
             # empty-case contract as ServeMetrics.summary); recorded
             # samples overwrite them via the histogram merges below
@@ -503,6 +596,9 @@ class FleetMetrics:
         "fleet_migrations", "fleet_kv_handoff_pages",
         "fleet_decode_tokens", "fleet_tokens_by_adapter",
         "fleet_grammar_rejected_tokens", "fleet_stream_deliveries",
+        "fleet_pages_spilled", "fleet_pages_restored",
+        "fleet_spill_bytes", "fleet_restore_s", "fleet_directory_hits",
+        "fleet_directory_tokens_saved", "fleet_directory_invalidations",
     })
 
     def window(self, replicas: Sequence[dict] = (),
@@ -605,7 +701,9 @@ class Router:
                  auto_restart: bool = True, metrics: FleetMetrics = None,
                  observer=None, plan: Optional[FaultPlan] = None,
                  poll_s: float = 0.002, warmup: bool = True,
-                 exporter=None, slos=None, roles=None):
+                 exporter=None, slos=None, roles=None,
+                 prefix_directory: bool = True,
+                 affinity_min_tokens: Optional[int] = None):
         if isinstance(engines, (list, tuple)):
             engines = list(engines)
             if n_replicas is not None and n_replicas != len(engines):
@@ -723,9 +821,25 @@ class Router:
         self.replicas = [
             Replica(i, eng, sched_kwargs, plan, self.observer)
             for i, eng in enumerate(engines)]
+        # fleet-wide prefix directory (round 23): on paged engines the
+        # router learns which replica holds which chain-hashed page
+        # (from the replicas' kv_receipts, drained per tick) and routes
+        # a warm prefix to its holder when the expected prefill tokens
+        # saved clear ``affinity_min_tokens`` (default: one page —
+        # below that, least-loaded placement is worth more than the
+        # hit).  Purely advisory: see PrefixDirectory.
+        sizes = {eng.page_size for eng in engines}
+        self.prefix_dir = (PrefixDirectory()
+                           if prefix_directory and sizes != {0}
+                           and len(sizes) == 1 else None)
+        self._hash_pg = next(iter(sizes)) if len(sizes) == 1 else 0
+        if affinity_min_tokens is None:
+            affinity_min_tokens = self._hash_pg
+        self.affinity_min_tokens = max(1, affinity_min_tokens)
         self.health = [
-            ReplicaHealth(suspect_after, evict_after, recover_after)
-            for _ in engines]
+            ReplicaHealth(suspect_after, evict_after, recover_after,
+                          listener=self._directory_listener(i))
+            for i in range(len(engines))]
         self._cv = threading.Condition()
         self.queue: deque[_Flight] = deque()
         self._flights: dict[int, _Flight] = {}      # user rid -> flight
@@ -931,6 +1045,7 @@ class Router:
         # later ticks and still evicts in a handful of ms.
         self._tick_signaled.clear()
         self._collect()
+        self._drain_receipts()
         self._health_check()
         self._expire_queued()
         self._dispatch()
@@ -941,6 +1056,84 @@ class Router:
             # are consistent.  The exporter throttles itself — a tick
             # that lands inside interval_s costs one clock read.
             self.exporter.sample()
+
+    # ---- prefix directory ---------------------------------------------
+
+    def _directory_listener(self, i: int):
+        """Health-transition hook installed on replica ``i``'s
+        :class:`ReplicaHealth`: leaving HEALTHY for EVICTED or DRAINING
+        means the replica's arena is about to be lost (eviction) or
+        rebuilt (drain → restart), so everything it advertised is
+        delisted.  SUSPECT keeps its entries — the circuit may close
+        with the pages intact, and affinity already refuses
+        non-dispatchable owners."""
+        def _on_edge(prev: str, state: str, reason: str) -> None:
+            if state in (EVICTED, DRAINING):
+                self._invalidate_directory(i, reason)
+        return _on_edge
+
+    def _invalidate_directory(self, i: int, reason: str) -> None:
+        if self.prefix_dir is None:
+            return
+        n = self.prefix_dir.invalidate_replica(i)
+        if n:
+            self.metrics.on_directory_invalidate(n)
+            self.observer.event("prefix_directory_invalidated",
+                                replica=i, entries=n,
+                                reason=reason[:200])
+
+    def _drain_receipts(self) -> None:
+        """Fold every replica's ``kv_receipts`` into the directory,
+        once per pump tick.  Deque append/popleft are atomic, so this
+        never blocks a worker; a receipt published mid-drain simply
+        lands next tick — the directory is eventually consistent by
+        design (staleness costs a recompute, never wrong tokens)."""
+        if self.prefix_dir is None:
+            return
+        for i, rep in enumerate(self.replicas):
+            rec = rep.sched.kv_receipts
+            while True:
+                try:
+                    op, h = rec.popleft()
+                except IndexError:
+                    break
+                if op == "add":
+                    self.prefix_dir.add(h, i)
+                elif op == "drop":
+                    self.prefix_dir.drop(h, i)
+                else:            # "reset": a containment wiped the arena
+                    self._invalidate_directory(i, "containment reset")
+
+    def _affinity(self, fl: _Flight) -> Optional[tuple[int, int, int]]:
+        """Directory consult for one dispatch: ``(replica, n_pages,
+        tokens_saved)`` when prefix affinity should override
+        least-loaded, else None.  Affinity must clear every gate the
+        normal pick enforces (dispatchable, role, capacity) PLUS the
+        tokens-saved threshold — a one-page hit never justifies
+        loading a hot replica.  Migrated decode halves are excluded:
+        they carry their own pages (PR 14 handoff) and owe no prefill.
+        Caller holds the router lock."""
+        if self.roles is not None and fl.stage != "prefill":
+            return None
+        prompt = fl.req.prompt
+        if len(prompt) <= self._hash_pg:
+            return None
+        owner, n = self.prefix_dir.lookup(
+            page_chain_hashes(prompt[:len(prompt) - 1], self._hash_pg))
+        if owner is None:
+            return None
+        saved = n * self._hash_pg
+        if saved < self.affinity_min_tokens:
+            return None
+        if not self.health[owner].dispatchable:
+            return None
+        if not self._role_ok(owner, fl.stage if self.roles is not None
+                             else None):
+            return None
+        if (self.replicas[owner].load
+                >= 2 * self.replicas[owner].engine.n_slots):
+            return None
+        return owner, n, saved
 
     # ---- completions --------------------------------------------------
 
@@ -1257,6 +1450,17 @@ class Router:
                     head_stage = (self.queue[0].stage
                                   if self.roles is not None else None)
                     target = self._pick(stage=head_stage)
+                    aff = None
+                    if target is not None and self.prefix_dir is not None:
+                        # prefix affinity (round 23): when the head
+                        # flight's warm prefix lives on a specific
+                        # replica AND the expected tokens saved clear
+                        # the threshold, that replica beats the
+                        # least-loaded pick (all other dispatch gates
+                        # re-checked inside _affinity)
+                        aff = self._affinity(self.queue[0])
+                        if aff is not None:
+                            target = aff[0]
                     if target is None:
                         # SUSPECT and DRAINING recover; a fleet that is
                         # ENTIRELY evicted (no auto_restart) never will
@@ -1311,6 +1515,13 @@ class Router:
                                 "replica evicted)",
                             self.metrics.on_failed)
                     return
+                if aff is not None:
+                    self.metrics.on_directory_hit(aff[2])
+                    self.replicas[target].metrics.on_directory_hit()
+                    self.observer.event(
+                        "prefix_directory_hit",
+                        rid=corr_rid(fl.req.rid), replica=target,
+                        pages=aff[1], tokens_saved=aff[2])
                 self.observer.event("request_dispatched",
                                     rid=corr_rid(fl.req.rid),
                                     arid=corr_rid(att.rid),
@@ -1528,6 +1739,8 @@ class Router:
             health=[h.state for h in self.health])
         if self.roles is not None:
             out["replica_roles"] = list(self.roles)
+        if self.prefix_dir is not None:
+            out["prefix_directory_entries"] = len(self.prefix_dir)
         if self.exporter is not None:
             out["export_snapshots"] = self.exporter.n_snapshots
         if self.slo is not None:

@@ -67,7 +67,7 @@ class ReplicaHealth:
     """
 
     def __init__(self, suspect_after: int = 1, evict_after: int = 2,
-                 recover_after: int = 2):
+                 recover_after: int = 2, listener=None):
         for name, v in (("suspect_after", suspect_after),
                         ("evict_after", evict_after),
                         ("recover_after", recover_after)):
@@ -81,6 +81,10 @@ class ReplicaHealth:
         self.probe_fail_streak = 0
         self.probe_ok_streak = 0
         self.transitions: list[tuple[float, str, str, str]] = []
+        # optional ``listener(from, to, reason)`` fired on every edge —
+        # how the Router's prefix directory learns a replica's pages
+        # are no longer worth routing to (round 23)
+        self.listener = listener
 
     @property
     def dispatchable(self) -> bool:
@@ -91,9 +95,12 @@ class ReplicaHealth:
 
     def _to(self, state: str, reason: str) -> None:
         if state != self.state:
+            prev = self.state
             self.transitions.append(
-                (time.perf_counter(), self.state, state, reason))
+                (time.perf_counter(), prev, state, reason))
             self.state = state
+            if self.listener is not None:
+                self.listener(prev, state, reason)
 
     # ---- signal intake ------------------------------------------------
 

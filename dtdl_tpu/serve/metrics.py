@@ -143,6 +143,22 @@ class ServeMetrics:
         self.n_decode_steps_delayed = 0
         self.n_kv_handoff_pages = 0
         self.kv_handoff_s = 0.0
+        # hierarchical KV cache (round 23): pages demoted to the
+        # host/disk spill tiers on eviction, pages restored from them on
+        # a prefix miss (each restored page is prefill recompute the
+        # hierarchy saved), bytes and host seconds both ways, per-tier
+        # hit split, quarantined disk records, and requests routed here
+        # by the fleet prefix directory
+        self.pages_spilled = 0
+        self.pages_restored = 0
+        self.spill_bytes = 0
+        self.restore_bytes = 0
+        self.spill_s = 0.0
+        self.restore_s = 0.0
+        self.spill_host_hits = 0
+        self.spill_disk_hits = 0
+        self.spill_quarantined = 0
+        self.directory_hits = 0
         # multi-tenant serving (round 22): delivered generated tokens
         # keyed by adapter name ("base" = no adapter), draft tokens the
         # grammar automaton trimmed before verify, and incremental
@@ -242,6 +258,38 @@ class ServeMetrics:
         disaggregation path."""
         self.n_kv_handoff_pages += pages
         self.kv_handoff_s += seconds
+
+    def on_spill(self, pages: int, nbytes: int, seconds: float):
+        """One batched spill-on-evict: ``pages`` evicted pages extracted
+        to the host tier in ONE device_get sync costing ``seconds`` of
+        host time, ``nbytes`` moved.  The write half of the memory-
+        hierarchy ledger."""
+        self.pages_spilled += pages
+        self.spill_bytes += nbytes
+        self.spill_s += seconds
+
+    def on_restore(self, pages: int, nbytes: int, seconds: float,
+                   host_hits: int = 0, disk_hits: int = 0):
+        """One admission's restore-from-spill: ``pages`` spilled pages
+        re-entered the HBM arena through inject (dispatch-only — no
+        sync), so their prompt tokens skipped recompute-prefill.
+        ``host_hits``/``disk_hits`` split the pages by serving tier."""
+        self.pages_restored += pages
+        self.restore_bytes += nbytes
+        self.restore_s += seconds
+        self.spill_host_hits += host_hits
+        self.spill_disk_hits += disk_hits
+
+    def on_spill_quarantine(self, n: int):
+        """``n`` disk spill records failed integrity and were
+        quarantined by name (the affected prefixes fell back to
+        recompute — a perf event, never a correctness one)."""
+        self.spill_quarantined += n
+
+    def on_directory_hit(self):
+        """The fleet prefix directory routed a request here because
+        this replica holds its prefix (affinity beat least-loaded)."""
+        self.directory_hits += 1
 
     def on_draft(self, seconds: float):
         """One drafting phase's host time (dispatch-side; drafted/
@@ -359,6 +407,17 @@ class ServeMetrics:
             "decode_steps_delayed_by_prefill": self.n_decode_steps_delayed,
             "kv_handoff_pages": self.n_kv_handoff_pages,
             "kv_handoff_s": round(self.kv_handoff_s, 6),
+            # hierarchical KV cache (round 23): the spill/restore ledger
+            "pages_spilled": self.pages_spilled,
+            "pages_restored": self.pages_restored,
+            "spill_bytes": self.spill_bytes,
+            "restore_bytes": self.restore_bytes,
+            "spill_s": round(self.spill_s, 6),
+            "restore_s": round(self.restore_s, 6),
+            "spill_host_hits": self.spill_host_hits,
+            "spill_disk_hits": self.spill_disk_hits,
+            "spill_quarantined": self.spill_quarantined,
+            "directory_hits": self.directory_hits,
             # multi-tenant serving (round 22): per-tenant goodput split
             # plus the constrained-decode and streaming ledgers
             "tokens_by_adapter": dict(self.tokens_by_adapter),
@@ -404,6 +463,10 @@ class ServeMetrics:
         "decode_steps_delayed_by_prefill", "kv_handoff_pages",
         "kv_handoff_s", "tokens_by_adapter", "grammar_rejected_tokens",
         "stream_deliveries",
+        # hierarchical KV cache (round 23)
+        "pages_spilled", "pages_restored", "spill_bytes",
+        "restore_bytes", "spill_s", "restore_s", "spill_host_hits",
+        "spill_disk_hits", "spill_quarantined", "directory_hits",
     })
 
     def window(self) -> dict:

@@ -39,7 +39,68 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+
+# ---------------------------------------------------------------------------
+# bitset grammar masks (round 23, the PR 17 known-remaining perf fix):
+# the scheduler builds [*, V] bool masks on the host every constrained
+# step, and uploading V bytes of bools per slot per step is 8x the
+# information content.  pack_mask() packs them into uint32 words on the
+# host (V/32 words -> V/8 bytes, an 8x cut in host->device mask bytes);
+# unpack_mask() expands them back to bool ON DEVICE inside the compiled
+# programs, where the [*, V] intermediate is free compared to the
+# transfer.  sample()/accept_resample() auto-detect packed masks by
+# dtype, so the dense-bool path survives untouched as the
+# token-identity oracle (tests pin packed == dense).
+# ---------------------------------------------------------------------------
+
+MASK_WORD_BITS = 32
+
+
+def mask_words(vocab: int) -> int:
+    """uint32 words one packed mask row spends on ``vocab`` tokens."""
+    return -(-vocab // MASK_WORD_BITS)
+
+
+def pack_mask(allowed):
+    """Pack a host [..., V] bool grammar mask into [..., ceil(V/32)]
+    uint32 words (token v lives at bit ``v % 32`` of word ``v // 32``).
+    Pure host numpy — call BEFORE upload; already-packed uint32 input
+    passes through unchanged (idempotent, so engine entry points can
+    accept either form)."""
+    # audit: ok[host-sync-asarray] grammar masks are host numpy by contract — packing happens before upload
+    a = np.asarray(allowed)
+    if a.dtype == np.uint32:
+        return a
+    a = a.astype(bool)
+    vocab = a.shape[-1]
+    pad = mask_words(vocab) * MASK_WORD_BITS - vocab
+    if pad:
+        a = np.concatenate(
+            [a, np.zeros(a.shape[:-1] + (pad,), bool)], axis=-1)
+    bits = a.reshape(a.shape[:-1] + (mask_words(vocab), MASK_WORD_BITS))
+    shifts = np.arange(MASK_WORD_BITS, dtype=np.uint32)
+    return (bits.astype(np.uint32) << shifts).sum(
+        axis=-1, dtype=np.uint32)
+
+
+def unpack_mask(packed, vocab: int):
+    """Expand a packed [..., W] uint32 mask back to [..., vocab] bool —
+    ON DEVICE (traced inside the compiled programs): a gather of each
+    token's word plus a shift-and-test, no host involvement."""
+    word = jnp.arange(vocab) // MASK_WORD_BITS
+    bit = jnp.arange(vocab) % MASK_WORD_BITS
+    return ((packed[..., word] >> bit.astype(jnp.uint32)) & 1).astype(bool)
+
+
+def _as_dense_mask(allowed, vocab: int):
+    """Dense [..., vocab] bool view of a grammar mask that may arrive
+    packed (uint32 words) or dense (bool) — the one detection point
+    sample()/accept_resample() share."""
+    if allowed.dtype == jnp.uint32:
+        return unpack_mask(allowed, vocab)
+    return allowed
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,16 +245,19 @@ def sample(logits, key, temperature, top_k, top_p, allowed=None):
     dynamic (see module docstring).  Rows whose temperature is 0 return
     the raw argmax regardless of their top-k/top-p settings.
 
-    ``allowed`` ([B, V] bool, optional) is the grammar mask of round 22
+    ``allowed`` ([B, V] bool, or [B, ceil(V/32)] uint32 bitset — see
+    :func:`pack_mask`) is the grammar mask of round 22
     (dtdl_tpu/serve/tenant/grammar.py): disallowed tokens drop to -inf
     BEFORE the greedy argmax and the top-k/top-p truncation, so a
     constrained slot samples from the renormalized legal distribution
     and a greedy constrained slot takes the best LEGAL token.  Like
     every other knob it is per-slot data; an all-true mask is
-    bit-identical to ``None``.
+    bit-identical to ``None``, and a packed mask is token-identical to
+    the dense bool it packs (the round-23 pin).
     """
     if allowed is not None:
-        logits = jnp.where(allowed, logits, -jnp.inf)
+        logits = jnp.where(_as_dense_mask(allowed, logits.shape[-1]),
+                           logits, -jnp.inf)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     masked = filter_logits(logits, temperature, top_k, top_p)
     drawn = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
@@ -245,17 +309,19 @@ def accept_resample(logits, draft, draft_len, key, temperature, top_k,
     argmax, the token-identity contract).  ``None`` (the default) is
     byte-identical to the pre-round-19 behavior.
 
-    ``allowed`` ([B, k+1, V] bool, optional): per-POSITION grammar
-    masks (round 22).  The scheduler builds them host-side by walking
-    the token DFA along the draft it is about to dispatch, so position
-    i's mask is conditioned on drafts 0..i-1 being accepted — masking
-    all k+1 positions is what lets constrained requests keep
-    speculating.  Applied before the argmaxes and the filter sweep,
-    exactly as in :func:`sample`; all-true is bit-identical to
-    ``None``.
+    ``allowed`` ([B, k+1, V] bool, or [B, k+1, ceil(V/32)] uint32
+    bitset — see :func:`pack_mask`): per-POSITION grammar masks (round
+    22).  The scheduler builds them host-side by walking the token DFA
+    along the draft it is about to dispatch, so position i's mask is
+    conditioned on drafts 0..i-1 being accepted — masking all k+1
+    positions is what lets constrained requests keep speculating.
+    Applied before the argmaxes and the filter sweep, exactly as in
+    :func:`sample`; all-true is bit-identical to ``None`` and packed is
+    token-identical to dense.
     """
     if allowed is not None:
-        logits = jnp.where(allowed, logits, -jnp.inf)
+        logits = jnp.where(_as_dense_mask(allowed, logits.shape[-1]),
+                           logits, -jnp.inf)
     B, k1, V = logits.shape
     k = k1 - 1
     greedy_row = temperature <= 0.0                          # [B]

@@ -107,8 +107,9 @@ from dtdl_tpu.obs.trace import corr_rid
 from dtdl_tpu.serve.draft import DraftSource, NGramDraft
 from dtdl_tpu.serve.engine import InferenceEngine, PromptTooLongError
 from dtdl_tpu.serve.metrics import ERROR_KINDS, ServeMetrics
-from dtdl_tpu.serve.paged import (GARBAGE_PAGE, PageAllocator,
-                                  PagePoolExhaustedError)
+from dtdl_tpu.serve.paged import (GARBAGE_PAGE, DiskPageStore,
+                                  HostPageStore, PageAllocator,
+                                  PagePoolExhaustedError, payload_nbytes)
 from dtdl_tpu.serve.sampling import GREEDY, SampleParams
 from dtdl_tpu.serve.tenant.lora import AdapterBankFullError
 
@@ -340,7 +341,10 @@ class Scheduler:
                  observer=None, draft: Optional[DraftSource] = None,
                  max_queue: Optional[int] = None,
                  prefix_cache: bool = True, exporter=None,
-                 chunk_tokens: Optional[int] = None):
+                 chunk_tokens: Optional[int] = None,
+                 spill_host_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 spill_disk_bytes: Optional[int] = None):
         if harvest_lag < 0:
             raise ValueError(f"harvest_lag must be >= 0, got "
                              f"{harvest_lag}")
@@ -407,6 +411,16 @@ class Scheduler:
         # mapped waits in the queue (FIFO backpressure) until
         # retirements free pages or the prefix cache eats the need.
         self.pages: Optional[PageAllocator] = None
+        # hierarchical KV cache (round 23): the host-DRAM spill tier
+        # (plus optional disk tier) behind the HBM prefix cache, and the
+        # bounded receipt queue the fleet Router drains to keep its
+        # prefix directory fresh — ("add", hash) when this replica
+        # publishes a prefix page in ANY tier, ("drop", hash) when the
+        # last tier forgets it, ("reset", 0) on containment.  A dropped
+        # receipt (deque overflow) only makes the directory stale, and a
+        # stale directory entry only costs a recompute.
+        self.spill: Optional[HostPageStore] = None
+        self.kv_receipts: deque = deque(maxlen=65536)
         if engine.paged:
             self.pages = PageAllocator(engine.n_pages, engine.page_size,
                                        prefix_cache=prefix_cache)
@@ -414,6 +428,22 @@ class Scheduler:
                                  GARBAGE_PAGE, np.int32)
             self._slot_pages: list[list[int]] = \
                 [[] for _ in range(engine.n_slots)]
+            if spill_host_bytes is not None or spill_dir is not None:
+                if not prefix_cache:
+                    raise ValueError("spill tiers require "
+                                     "prefix_cache=True (spilled pages "
+                                     "are keyed by chain hash)")
+                disk = (DiskPageStore(spill_dir, spill_disk_bytes)
+                        if spill_dir is not None else None)
+                self.spill = HostPageStore(
+                    spill_host_bytes if spill_host_bytes is not None
+                    else 0,
+                    disk=disk,
+                    on_drop=lambda h: self.kv_receipts.append(("drop", h)))
+                self.pages.record_evictions = True
+        elif spill_host_bytes is not None or spill_dir is not None:
+            raise ValueError("spill_host_bytes/spill_dir require a paged "
+                             "engine with prefix_cache=True")
         # chunked prefill (round 19, Sarathi-style): prompt processing
         # split into <= chunk_tokens-per-step windows riding the verify
         # program family, so a long admission no longer stalls every
@@ -838,6 +868,10 @@ class Scheduler:
                 self.pages.reset()
                 self._ptab[:] = GARBAGE_PAGE
                 self._slot_pages = [[] for _ in range(self.engine.n_slots)]
+                # tell the fleet directory every HBM-resident hash this
+                # replica advertised is gone (host/disk spill copies
+                # survive — they are content-addressed host memory)
+                self.kv_receipts.append(("reset", 0))
         finally:
             self._containing = False
 
@@ -859,7 +893,7 @@ class Scheduler:
                 continue               # shed/failed with a named error
             chunked = self.chunk_tokens is not None
             suffix, start, row = req.prompt, 0, None
-            hits, fresh, hashes = [], [], []
+            hits, fresh, hashes, restored = [], [], [], []
             if self.pages is not None:
                 # paged admission: gate on FREE PAGES.  Match the
                 # longest cached run of full prompt pages (mapped
@@ -871,29 +905,64 @@ class Scheduler:
                 pg = self.engine.page_size
                 prompt = [int(t) for t in req.prompt]
                 hits = self.pages.match_prefix(prompt)
+                # hashing is O(prompt) host work on the TTFT path —
+                # skip it entirely when the cache can never hit
+                hashes = (self.pages.page_hashes(prompt)
+                          if self.pages.prefix_cache else [])
+                if self.spill is not None:
+                    # restore-on-miss (round 23): continue the chain
+                    # walk into the host/disk spill tiers — every
+                    # payload found there is one page of prefill
+                    # recompute skipped for a host->HBM copy
+                    for i in range(len(hits),
+                                   (len(prompt) - 1) // pg):
+                        tier = self.spill.holds(hashes[i])
+                        payload = (self.spill.get(hashes[i])
+                                   if tier is not None else None)
+                        if payload is None:
+                            if tier == "disk":
+                                # held by the manifest but failed its
+                                # integrity check: quarantined by the
+                                # store, recomputed by us
+                                self.metrics.on_spill_quarantine(1)
+                            break             # miss: recompute
+                        restored.append((payload, tier))
+
+                def resident() -> int:
+                    # prompt pages already materialized across ALL
+                    # tiers: HBM hits + spill-tier payloads to inject
+                    return len(hits) + len(restored)
+
+                def drop_one() -> None:
+                    # trim trailing resident pages (restored first —
+                    # they sit after the HBM hits on the chain; their
+                    # payloads stay warm in the spill store)
+                    (restored if restored else hits).pop()
                 if chunked:
                     # chunks write EXACT positions (no padded bucket),
                     # so the bucket-overshoot cap does not apply; the
                     # one constraint is never stranding a 1-token final
                     # chunk at position max_seq-1 (a k>=1 verify window
                     # there would clamp backward over cached pages)
-                    while hits and len(prompt) == self.engine.max_seq \
-                            and len(prompt) - len(hits) * pg < 2:
-                        hits.pop()
+                    while resident() \
+                            and len(prompt) == self.engine.max_seq \
+                            and len(prompt) - resident() * pg < 2:
+                        drop_one()
                 else:
                     # the suffix's PADDED bucket must also fit max_seq —
                     # the kernel clamps an overshooting window backward,
                     # which would scatter over the cached pages
-                    # themselves.  Dropping trailing hits grows the
-                    # suffix (monotonic: zero hits == the submit-checked
-                    # full prompt), so this always terminates on a
-                    # valid configuration.
-                    while hits and (len(hits) * pg
-                                    + self.engine.bucket_for(
-                                        len(prompt) - len(hits) * pg)
-                                    > self.engine.max_seq):
-                        hits.pop()
-                start = len(hits) * pg
+                    # themselves.  Dropping trailing resident pages
+                    # grows the suffix (monotonic: zero resident == the
+                    # submit-checked full prompt), so this always
+                    # terminates on a valid configuration.
+                    while resident() and (resident() * pg
+                                          + self.engine.bucket_for(
+                                              len(prompt)
+                                              - resident() * pg)
+                                          > self.engine.max_seq):
+                        drop_one()
+                start = resident() * pg
                 n_prompt_pages = -(-len(prompt) // pg)
                 need = n_prompt_pages - len(hits)
                 # pinning an evictable (refcount-0) hit consumes one
@@ -907,17 +976,52 @@ class Scheduler:
                 for p in hits:          # pin BEFORE alloc can evict them
                     self.pages.acquire(p)
                 fresh = [self.pages.alloc() for _ in range(need)]
+                # the alloc burst above may have evicted cached pages:
+                # extract their payloads to the spill store NOW, before
+                # the inject/prefill dispatches below rewrite them
+                self._spill_evicted()
                 row = np.full(self.engine.n_ptab, GARBAGE_PAGE, np.int32)
                 row[:len(hits)] = hits
                 row[len(hits):n_prompt_pages] = fresh
                 suffix = prompt[start:]
-                # hashing is O(prompt) host work on the TTFT path —
-                # skip it entirely when the cache can never hit
-                hashes = (self.pages.page_hashes(prompt)
-                          if self.pages.prefix_cache else [])
             self.queue.popleft()
             sp = req.sampling
             corr = self._corr(req)
+            if restored:
+                # restore-on-miss, entry half: the spilled payloads
+                # re-enter the arena through the SAME compiled scatter
+                # as the PR 14 handoff (fresh pages fresh[:n_res];
+                # dispatch-only — the suffix prefill below is ordered
+                # after it on the device stream, and its index/last
+                # seeding is overwritten by that prefill)
+                t0 = time.perf_counter()
+                payloads = [p for p, _ in restored]
+                data = (payloads[0] if len(payloads) == 1
+                        else jax.tree.map(
+                            lambda *xs: np.concatenate(xs, axis=0),
+                            *payloads))
+                try:
+                    self.arena, self.last_tokens = \
+                        self.engine.inject_pages(
+                            self.arena, self.last_tokens, data,
+                            fresh[:len(restored)], slot, start, 0)
+                except Exception as e:
+                    self._contain(e)
+                    self._finish_error(
+                        req, f"engine failure: {self.last_engine_error}",
+                        self.metrics.on_failure, "failed")
+                    if aid:   # not slotted yet — _contain missed it
+                        self.engine.adapter_bank.release(aid)
+                    return
+                dt = time.perf_counter() - t0
+                nbytes = sum(payload_nbytes(p) for p in payloads)
+                self.metrics.on_restore(
+                    len(restored), nbytes, dt,
+                    host_hits=sum(1 for _, t in restored if t == "host"),
+                    disk_hits=sum(1 for _, t in restored if t == "disk"))
+                self.observer.event(
+                    "page_restored", slot=slot, pages=len(restored),
+                    nbytes=nbytes, cached=len(hits) * pg, **corr)
             if not chunked:
                 # whole-prompt prefill: one blocking compiled call —
                 # every in-flight decode waits a full prefill latency
@@ -956,19 +1060,31 @@ class Scheduler:
             if self.pages is not None:
                 self._ptab[slot] = row
                 self._slot_pages[slot] = list(hits) + list(fresh)
+                n_res = len(restored)
+                # restored pages' contents are complete at the inject
+                # dispatch above: publish them back into the HBM cache
+                # now, whichever prefill path follows
+                for i in range(len(hits), len(hits) + n_res):
+                    self.pages.register(hashes[i], int(row[i]))
+                    self.kv_receipts.append(("add", hashes[i]))
                 if chunked:
-                    # registration waits for the final chunk: only then
-                    # are the prompt's pages fully written
-                    self._slot_hashes[slot] = (hashes, len(hits))
+                    # registration of the SUFFIX pages waits for the
+                    # final chunk: only then are they fully written
+                    self._slot_hashes[slot] = (hashes,
+                                               len(hits) + n_res)
                 else:
                     # publish the freshly-computed FULL prompt pages
                     # under their chain hashes — the next identical
                     # prefix hits (deterministic model: same tokens at
                     # same positions => identical K/V, so
                     # first-writer-wins is sound)
-                    for i in range(len(hits), len(hashes)):
+                    for i in range(len(hits) + n_res, len(hashes)):
                         self.pages.register(hashes[i], int(row[i]))
-                self.metrics.on_prefix(len(hits), len(hashes), start)
+                        self.kv_receipts.append(("add", hashes[i]))
+                # resident prefix pages — HBM hits AND spill restores —
+                # all count as hits: their tokens skipped recompute
+                self.metrics.on_prefix(len(hits) + n_res, len(hashes),
+                                       start)
             self.slots[slot] = req
             self._active[slot] = True
             self._aids[slot] = aid
@@ -1050,6 +1166,8 @@ class Scheduler:
         self.queue.popleft()
         corr = self._corr(req)
         fresh = [self.pages.alloc() for _ in range(n_pg)]
+        # evictions from the alloc burst spill before inject overwrites
+        self._spill_evicted()
         row = np.full(self.engine.n_ptab, GARBAGE_PAGE, np.int32)
         row[:n_pg] = fresh
         t0 = time.perf_counter()
@@ -1078,6 +1196,7 @@ class Scheduler:
             prompt = [int(t) for t in req.prompt]
             for h, p in zip(self.pages.page_hashes(prompt), fresh):
                 self.pages.register(h, int(p))
+                self.kv_receipts.append(("add", h))
         self.metrics.on_kv_handoff(n_pg, time.perf_counter() - t0)
         sp = req.sampling
         self.slots[slot] = req
@@ -1156,6 +1275,39 @@ class Scheduler:
                 self.observer.event("page_pool_shed", slot=slot,
                                     **self._corr(req))
                 self._retire(slot)
+        # growth may have evicted cached pages; spill them before the
+        # caller's dispatch rewrites them
+        self._spill_evicted()
+
+    def _spill_evicted(self) -> None:
+        """Drain the allocator's pending evictions into the spill store
+        with ONE batched extract (round 23).  Must run after any alloc
+        burst and BEFORE the next program dispatch rewrites the evicted
+        pages — ``extract_pages_batch`` is a host sync, so the payloads
+        are safely on the host before anything else reaches the device
+        stream.  Best-effort by design: a failure here drops the
+        payloads (those prefixes recompute later) and never breaks
+        admission or a live decode."""
+        if self.spill is None or self.pages is None \
+                or not self.pages.pending_spills:
+            return
+        evs = self.pages.pending_spills
+        self.pages.pending_spills = []
+        t0 = time.perf_counter()
+        try:
+            data = self.engine.extract_pages_batch(
+                self.arena, [p for _, p in evs])
+        except Exception:
+            return
+        dt = time.perf_counter() - t0
+        nbytes = 0
+        for i, (h, _) in enumerate(evs):
+            payload = jax.tree.map(lambda a, i=i: a[i:i + 1], data)
+            nbytes += payload_nbytes(payload)
+            self.spill.put(h, payload)
+        self.metrics.on_spill(len(evs), nbytes, dt)
+        self.observer.event("page_spilled", pages=len(evs),
+                            nbytes=nbytes, host_pages=len(self.spill))
 
     # ---- drafting -----------------------------------------------------
 
@@ -1465,6 +1617,7 @@ class Scheduler:
                     row = self._ptab[slot]
                     for i in range(n_hits, len(hashes)):
                         self.pages.register(hashes[i], int(row[i]))
+                        self.kv_receipts.append(("add", hashes[i]))
                     self._slot_hashes[slot] = None
         else:
             entries = tuple(

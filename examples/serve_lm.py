@@ -67,6 +67,17 @@ def main():
          help="synthetic traffic: give every request this many common "
               "leading tokens (a system prompt) so the prefix cache "
               "has something to hit")
+    flag(parser, "--spill-host-mb", type=int, default=0,
+         help="hierarchical KV cache (round 23): host-DRAM spill store "
+              "byte budget in MiB (0 = off); evicted refcount-0 cached "
+              "pages spill instead of freeing and a prefix miss "
+              "restores them (needs --page-size + --prefix-cache)")
+    flag(parser, "--spill-dir", default="",
+         help="disk spill tier for --spill-host-mb: directory for the "
+              "checksummed mmap'd spill file (host overflow demotes "
+              "there; corrupt entries quarantine and recompute)")
+    flag(parser, "--spill-disk-mb", type=int, default=256,
+         help="disk spill file byte budget in MiB for --spill-dir")
     flag(parser, "--chunk-tokens", type=int, default=0,
          help="chunked prefill: per-step prompt token budget (0 = "
               "whole-prompt prefill); long admissions stop stalling "
@@ -151,7 +162,11 @@ def main():
     sched = Scheduler(engine, seed=args.seed,
                       harvest_lag=args.harvest_lag, observer=obs,
                       draft=draft, prefix_cache=args.prefix_cache,
-                      chunk_tokens=args.chunk_tokens or None)
+                      chunk_tokens=args.chunk_tokens or None,
+                      spill_host_bytes=args.spill_host_mb << 20 or None,
+                      spill_dir=args.spill_dir or None,
+                      spill_disk_bytes=(args.spill_disk_mb << 20
+                                        if args.spill_dir else None))
     sp = SampleParams(temperature=args.temperature, top_k=args.top_k,
                       top_p=args.top_p)
 
@@ -229,6 +244,15 @@ def main():
               f"{s['pages_in_use_last']}/{s['page_capacity']} "
               f"(peak {s['pages_in_use_peak']})  shed "
               f"{s['requests_shed']}")
+    if args.spill_host_mb:
+        # the hierarchy receipts: pages that left HBM and came back
+        # instead of being recomputed, split by the tier that hit
+        print(f"  kv spill: spilled {s['pages_spilled']} pages "
+              f"({s['spill_bytes'] >> 10} KiB)  restored "
+              f"{s['pages_restored']} (host {s['spill_host_hits']} / "
+              f"disk {s['spill_disk_hits']} hits, "
+              f"{s['restore_s'] * 1e3:.1f}ms)  quarantined "
+              f"{s['spill_quarantined']}")
     if args.quantize != "none":
         # the quantization receipts: decode bytes/token (the TPU
         # roofline numerator), KV capacity gained at fixed HBM, and the

@@ -64,6 +64,7 @@ def test_evaluate_reads_latest_checkpoint(tmp_path, devices):
     assert r1["accuracy"] > 0.8, r1
 
 
+@pytest.mark.slow
 def test_train_and_evaluate_alternates(tmp_path, devices):
     train_fn, eval_fn = loaders()
     est = Estimator(model_fn, str(tmp_path), RunConfig(

@@ -245,6 +245,7 @@ def test_4d_eval_step_matches_oracle(devices, n_experts, dispatch):
     assert float(got2["loss"]) == float(got["loss"])
 
 
+@pytest.mark.slow
 def test_4d_step_loss_decreases(devices):
     cfg = _cfg(n_experts=4)
     mesh = M.build_4d_mesh(devices)
@@ -403,6 +404,7 @@ def test_factor_mesh():
         assert m <= 8 and p <= 4
 
 
+@pytest.mark.slow
 def test_moe_capacity_overflow_drops_and_reports(devices):
     """A starved capacity factor must drop tokens (Switch semantics), report
     an exact dropped fraction, and still train to a finite loss."""
@@ -480,6 +482,7 @@ def test_1f1b_four_stages(devices, n_micro):
     _oracle_and_step(cfg, mesh, _batch(cfg, B=8, S=32, seed=21), seed=22)
 
 
+@pytest.mark.slow
 def test_1f1b_vocab_indivisible_replicated_head(devices):
     """vocab_size=63 with tp=2: the replicated-head fallback's pmean-based
     grad path must still match the oracle (round-2 advisor ask)."""
@@ -645,6 +648,7 @@ def test_to_flax_model_mirrors_config():
     assert M.to_flax_model(cfg, max_seq=4096).max_seq == 4096
 
 
+@pytest.mark.slow
 def test_to_flax_model_roundtrip_trained_params(devices):
     """The serving bridge on TRAINED weights: run real 4D train steps,
     convert with to_flax_model + to_flax_params, and pin logits parity of

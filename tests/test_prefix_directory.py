@@ -1,0 +1,238 @@
+"""Fleet-wide prefix directory (round 23, serve/fleet.py).
+
+The Router turns N independent per-replica prefix caches into one
+logical cache: replicas publish chain-hash receipts, the Router folds
+them into a :class:`PrefixDirectory`, and dispatch routes warm-prefix
+traffic to the replica already holding the pages.  The directory is
+strictly advisory — every test here pins the two halves of that
+contract: (a) affinity actually lands hits (perf), and (b) staleness,
+eviction, and kills never cost a token or a request (correctness).
+
+Layout
+------
+* pure unit: PrefixDirectory lookup/ownership semantics, the health
+  listener plumbing;
+* routed: affinity steering on a live two-replica fleet, token
+  identity against a directory-off oracle;
+* faulted: replica kill mid-traffic — directory invalidated, zero
+  requests lost, zero token divergence.
+"""
+
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dtdl_tpu.models.transformer import transformer_lm
+from dtdl_tpu.resil import FaultPlan
+from dtdl_tpu.resil.faults import replica_site
+from dtdl_tpu.serve import (EVICTED, HEALTHY, SUSPECT, InferenceEngine,
+                            PrefixDirectory, ReplicaHealth, Request,
+                            Router, Scheduler, page_chain_hashes)
+
+MAX_SEQ = 48
+PAGE = 8
+SYS = list(range(1, 10))        # 9 tokens: one full page + one straggler
+
+
+@pytest.fixture(scope="module")
+def model():
+    return transformer_lm(
+        "tiny", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        d_ff=64, max_seq=MAX_SEQ, attn_impl="dense", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    params = nn.unbox(model.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 4), jnp.int32))["params"])
+    return InferenceEngine(model, params, n_slots=2, buckets=(8, 16),
+                           page_size=PAGE)
+
+
+def kw(**over):
+    base = dict(sched_kwargs={"harvest_lag": 1}, retry_budget=3,
+                probe_interval_s=0.01, watchdog_s=0.25)
+    base.update(over)
+    return base
+
+
+def warm_prompts(n):
+    """n distinct prompts sharing the SYS prefix (each fits bucket 16
+    and registers exactly one cached page on completion)."""
+    return [SYS + [20 + i, 21 + i] for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# PrefixDirectory: pure unit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_directory_lookup_longest_run_anchored_at_root():
+    d = PrefixDirectory()
+    for h in (10, 11, 12):
+        d.add(h, 3)
+    assert d.lookup([10, 11, 12]) == (3, 3)
+    assert d.lookup([10, 11]) == (3, 2)
+    # a hole mid-chain ends the run — page k is useless without 0..k-1
+    d.drop(11, 3)
+    assert d.lookup([10, 11, 12]) == (3, 1)
+    # a cold root credits nobody, even if later links are present
+    assert d.lookup([99, 10]) == (None, 0)
+    assert len(d) == 2
+
+
+@pytest.mark.fleet
+def test_directory_split_ownership_credits_first_owner_only():
+    d = PrefixDirectory()
+    d.add(10, 0)
+    d.add(11, 1)                 # chain continues on ANOTHER replica
+    assert d.lookup([10, 11]) == (0, 1)
+
+
+@pytest.mark.fleet
+def test_directory_last_writer_wins_and_owner_scoped_drop():
+    d = PrefixDirectory()
+    d.add(10, 0)
+    d.add(10, 1)                 # newest copy wins
+    assert d.lookup([10]) == (1, 1)
+    d.drop(10, 0)                # stale owner may NOT retract the entry
+    assert d.lookup([10]) == (1, 1)
+    d.drop(10, 1)
+    assert d.lookup([10]) == (None, 0)
+
+
+@pytest.mark.fleet
+def test_directory_invalidate_replica_bulk_drop():
+    d = PrefixDirectory()
+    for h in range(8):
+        d.add(h, h % 2)
+    assert d.invalidate_replica(0) == 4
+    assert len(d) == 4
+    assert all(d.lookup([h])[0] == 1 for h in range(1, 8, 2))
+    assert d.invalidate_replica(0) == 0      # idempotent
+
+
+@pytest.mark.fleet
+def test_health_listener_fires_on_every_edge():
+    edges = []
+    h = ReplicaHealth(suspect_after=1, evict_after=2, recover_after=1,
+                      listener=lambda a, b, r: edges.append((a, b, r)))
+    h.on_signal("boom")
+    h.on_signal("boom again")        # 1st strike while suspect
+    h.on_signal("boom, third")       # 2nd strike: evicted
+    assert [(a, b) for a, b, _ in edges] == \
+        [(HEALTHY, SUSPECT), (SUSPECT, EVICTED)]
+    assert all(r for _, _, r in edges)
+
+
+# ---------------------------------------------------------------------------
+# routed: affinity on a live fleet
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.fleet
+def test_directory_disabled_without_uniform_paging(model):
+    params = nn.unbox(model.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 4), jnp.int32))["params"])
+    dense = InferenceEngine(model, params, n_slots=2, buckets=(8, 16))
+    with Router(dense, n_replicas=2, **kw()) as router:
+        assert router.prefix_dir is None
+        router.run([Request(SYS + [20, 21], 3)])
+        assert "prefix_directory_entries" not in router.summary()
+
+
+@pytest.mark.fleet
+def test_affinity_routes_warm_prefix_and_matches_directory_off(engine):
+    """Two waves of shared-prefix traffic: wave 1 seeds the directory
+    via receipts, wave 2 is steered to the prefix holder.  The pin is
+    double: directory hits actually happen, AND every emitted token is
+    identical to a ``prefix_directory=False`` fleet over the same
+    engine (the directory may only change WHERE work runs)."""
+    reqs = lambda: [Request(list(p), 4) for p in warm_prompts(4)]
+    with Router(engine, n_replicas=2, prefix_directory=False,
+                **kw()) as off:
+        off.run(reqs())
+        want = [r.tokens for r in off.run(reqs())]
+    with Router(engine, n_replicas=2, **kw()) as router:
+        assert router.prefix_dir is not None
+        router.run(reqs())
+        time.sleep(0.05)          # let the last harvest's receipts land
+        wave2 = router.run(reqs())
+        s = router.summary()
+    assert all(r.error is None for r in wave2)
+    assert [r.tokens for r in wave2] == want
+    assert s["fleet_directory_hits"] >= 1
+    assert s["fleet_directory_tokens_saved"] >= PAGE
+    assert s["prefix_directory_entries"] >= 1
+    assert s["fleet_accounting_ok"]
+
+
+@pytest.mark.fleet
+def test_receipts_hash_space_matches_router(engine):
+    """The scheduler registers pages under the same chain hashes the
+    Router computes for routing — one hash space end to end."""
+    sched = Scheduler(engine)
+    sched.run([Request(SYS + [20, 21], 3)])
+    adds = {h for op, h in sched.kv_receipts if op == "add"}
+    prompt = SYS + [22, 23]
+    want = page_chain_hashes(prompt[:len(prompt) - 1], PAGE)
+    assert want and set(want) <= adds
+
+
+# ---------------------------------------------------------------------------
+# faulted: eviction and kills
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_directory_invalidated_on_replica_eviction(engine):
+    """Health edges into EVICTED bulk-drop the replica's directory
+    entries (the listener wired at Router construction), so no new
+    traffic is steered at a dead replica's pages."""
+    with Router(engine, n_replicas=2, **kw()) as router:
+        router.run([Request(list(p), 3) for p in warm_prompts(4)])
+        router._drain_receipts()          # fold any post-run receipts
+        assert len(router.prefix_dir) >= 1
+        owned = {router.prefix_dir._owner[h]
+                 for h in router.prefix_dir._owner}
+        before = router.metrics.directory_invalidations
+        for i in sorted(owned):           # evict every owner directly
+            for _ in range(16):
+                if router.health[i].on_signal("test: forced "
+                                              "eviction") == EVICTED:
+                    break
+            assert router.health[i].state == EVICTED
+        assert len(router.prefix_dir) == 0
+        assert router.metrics.directory_invalidations > before
+
+
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_kill_one_replica_lossless_with_directory_on(engine):
+    """The acceptance drill: warm the directory, kill a replica under
+    load, and require (a) zero requests lost — every request completes
+    with no failed/expired, (b) zero token divergence against a
+    directory-off oracle, (c) the dead replica's entries are gone."""
+    reqs = lambda: [Request(list(p), 4) for p in warm_prompts(6)]
+    with Router(engine, n_replicas=2, prefix_directory=False,
+                **kw()) as off:
+        off.run(reqs())
+        want = [r.tokens for r in off.run(reqs())]
+
+    plan = FaultPlan().at(replica_site(0, "loop"), 0)
+    with Router(engine, n_replicas=2, plan=plan, auto_restart=True,
+                **kw(watchdog_s=0.15)) as router:
+        router.run(reqs())                # replica 0 dies mid-wave-1
+        time.sleep(0.05)
+        wave2 = router.run(reqs())
+        s = router.summary()
+        trans = [(a, b) for _, a, b, _ in router.health[0].transitions]
+    assert all(r.error is None for r in wave2)
+    assert [r.tokens for r in wave2] == want
+    assert s["fleet_evictions"] >= 1
+    assert (SUSPECT, EVICTED) in trans
+    assert s["fleet_requests_failed"] == 0
+    assert s["fleet_requests_expired"] == 0
+    assert s["fleet_accounting_ok"], "requests lost in the drill"

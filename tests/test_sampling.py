@@ -153,3 +153,73 @@ def test_engine_kernels_receipt():
     # no prefill/decode/verify program was ever built for this receipt
     assert eng.compile_stats()["prefill"] == {}
     assert eng.compile_stats()["decode"] == 0
+
+
+# ---------------------------------------------------------------------------
+# packed grammar masks (round 23): uint32 bitsets vs the dense oracle
+# ---------------------------------------------------------------------------
+
+def test_pack_mask_roundtrip_and_idempotent():
+    """pack -> unpack is the identity for every vocab size near the
+    32-bit word boundary, and pack() of already-packed words is a
+    pass-through (engine entry points accept either form)."""
+    from dtdl_tpu.serve.sampling import mask_words, pack_mask, unpack_mask
+    rng = np.random.default_rng(7)
+    for vocab in (1, 31, 32, 33, 64, 100, 257):
+        dense = rng.random((3, vocab)) < 0.5
+        packed = pack_mask(dense)
+        assert packed.dtype == np.uint32
+        assert packed.shape == (3, mask_words(vocab))
+        # the wire win round 23 banks on: ~8x fewer host->device bytes
+        # than a bool [V] row (word padding dominates tiny vocabs)
+        if vocab >= 64:
+            assert packed.nbytes * 8 >= dense.nbytes >= packed.nbytes * 4
+        np.testing.assert_array_equal(
+            np.asarray(unpack_mask(jnp.asarray(packed), vocab)), dense)
+        np.testing.assert_array_equal(pack_mask(packed), packed)
+
+
+def test_sample_packed_mask_token_identical_to_dense():
+    """sample() under a packed uint32 grammar mask draws the SAME token
+    as under the dense bool mask, greedy and stochastic rows alike —
+    the round-22 constrained-decode pin survives the wire format."""
+    from dtdl_tpu.serve.sampling import pack_mask
+    rng = np.random.default_rng(11)
+    V = 100                                   # not a multiple of 32
+    logits = jnp.asarray(rng.normal(size=(5, V)) * 2, jnp.float32)
+    temp = jnp.asarray([0.0, 0.8, 1.2, 0.0, 0.5], jnp.float32)
+    top_k = jnp.asarray([0, 7, 0, 3, 0], jnp.int32)
+    top_p = jnp.asarray([1.0, 0.9, 0.6, 1.0, 0.8], jnp.float32)
+    dense = rng.random((5, V)) < 0.3
+    dense[:, 17] = True                       # every row keeps one legal
+    packed = jnp.asarray(pack_mask(dense))
+    dense = jnp.asarray(dense)
+    for s in range(4):
+        key = jax.random.PRNGKey(s)
+        got_d = sample(logits, key, temp, top_k, top_p, allowed=dense)
+        got_p = sample(logits, key, temp, top_k, top_p, allowed=packed)
+        np.testing.assert_array_equal(np.asarray(got_d), np.asarray(got_p))
+
+
+def test_accept_resample_packed_mask_token_identical_to_dense():
+    from dtdl_tpu.serve.sampling import accept_resample, pack_mask
+    rng = np.random.default_rng(13)
+    B, K, V = 4, 3, 100
+    logits = jnp.asarray(rng.normal(size=(B, K + 1, V)) * 2, jnp.float32)
+    draft = jnp.asarray(rng.integers(0, V, (B, K)), jnp.int32)
+    draft_len = jnp.asarray([3, 2, 0, 1], jnp.int32)
+    temp = jnp.asarray([0.0, 0.9, 0.0, 1.1], jnp.float32)
+    top_k = jnp.asarray([0, 5, 0, 0], jnp.int32)
+    top_p = jnp.asarray([1.0, 0.9, 1.0, 0.7], jnp.float32)
+    dense = rng.random((B, V)) < 0.4
+    dense[:, 23] = True
+    packed = jnp.asarray(pack_mask(dense))
+    dense = jnp.asarray(dense)
+    for s in range(3):
+        key = jax.random.PRNGKey(s)
+        tok_d, n_d = accept_resample(logits, draft, draft_len, key,
+                                     temp, top_k, top_p, allowed=dense)
+        tok_p, n_p = accept_resample(logits, draft, draft_len, key,
+                                     temp, top_k, top_p, allowed=packed)
+        np.testing.assert_array_equal(np.asarray(n_d), np.asarray(n_p))
+        np.testing.assert_array_equal(np.asarray(tok_d), np.asarray(tok_p))

@@ -134,6 +134,7 @@ def test_causality():
     assert np.abs(np.asarray(base[:, -1]) - np.asarray(out[:, -1])).max() > 0
 
 
+@pytest.mark.slow
 def test_lm_training_loss_decreases():
     m = transformer_lm("tiny", n_layers=1, remat=True)
     toks = _tokens(b=4, s=32)
